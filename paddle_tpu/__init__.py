@@ -1,0 +1,54 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of early-2018 PaddlePaddle (reference: /root/reference).
+
+Fluid-style usage (mirrors python/paddle/v2/fluid/__init__.py):
+
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": ..., "y": ...}, fetch_list=[cost])
+
+Programs are desc graphs (framework/core.py); execution compiles whole blocks
+to XLA (framework/executor.py)."""
+
+from . import layers  # noqa: F401
+from . import ops  # noqa: F401  (registers all op emitters)
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import io  # noqa: F401
+from .framework import initializer  # noqa: F401
+from .framework import unique_name  # noqa: F401
+from .framework.backward import append_backward  # noqa: F401
+from .framework.core import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+)
+from .framework.executor import Executor  # noqa: F401
+from .framework.place import CPUPlace, CUDAPlace, TPUPlace, default_place  # noqa: F401
+from .framework.scope import Scope, global_scope, reset_global_scope  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def reset():
+    """Fresh default programs + scope + name counters (test isolation)."""
+    switch_main_program(Program())
+    switch_startup_program(Program())
+    reset_global_scope()
+    unique_name.reset()

@@ -1,0 +1,79 @@
+"""Gradient clipping (reference python/paddle/v2/fluid/clip.py: error_clip +
+GradientClipByValue/ByNorm/ByGlobalNorm as program transforms on grads)."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+
+
+def _clip_out(block, grad):
+    return block.create_var(
+        name=unique_name.generate(grad.name + "_clip"),
+        shape=grad.shape, dtype=grad.dtype, stop_gradient=True)
+
+
+def append_gradient_clip_by_value(block, params_grads, vmin, vmax):
+    out = []
+    for p, g in params_grads:
+        c = _clip_out(block, g)
+        block.append_op("clip", inputs={"X": [g.name]},
+                        outputs={"Out": [c.name]},
+                        attrs={"min": float(vmin), "max": float(vmax)})
+        out.append((p, c))
+    return out
+
+
+def append_gradient_clip_by_norm(block, params_grads, max_norm):
+    out = []
+    for p, g in params_grads:
+        c = _clip_out(block, g)
+        block.append_op("clip_by_norm", inputs={"X": [g.name]},
+                        outputs={"Out": [c.name]},
+                        attrs={"max_norm": float(max_norm)})
+        out.append((p, c))
+    return out
+
+
+def append_gradient_clip_by_global_norm(block, params_grads, clip_norm):
+    """sum of squared norms across all grads → common scale factor."""
+    sq_names = []
+    for _, g in params_grads:
+        sq = block.create_var(name=unique_name.generate(g.name + "_sq"),
+                              shape=(1,), dtype="float32",
+                              stop_gradient=True)
+        block.append_op("squared_l2_norm", inputs={"X": [g.name]},
+                        outputs={"Out": [sq.name]})
+        sq_names.append(sq.name)
+    total = block.create_var(name=unique_name.generate("global_norm_sq"),
+                             shape=(1,), dtype="float32", stop_gradient=True)
+    block.append_op("sum", inputs={"X": sq_names},
+                    outputs={"Out": [total.name]})
+    norm = block.create_var(name=unique_name.generate("global_norm"),
+                            shape=(1,), dtype="float32", stop_gradient=True)
+    block.append_op("sqrt", inputs={"X": [total.name]},
+                    outputs={"Out": [norm.name]})
+    # scale = clip_norm / max(norm, clip_norm)
+    denom = block.create_var(name=unique_name.generate("global_norm_max"),
+                             shape=(1,), dtype="float32", stop_gradient=True)
+    cn = block.create_var(name=unique_name.generate("clip_norm_const"),
+                          shape=(1,), dtype="float32", stop_gradient=True)
+    block.append_op("fill_constant", outputs={"Out": [cn.name]},
+                    attrs={"shape": [1], "value": float(clip_norm),
+                           "dtype": "float32"})
+    block.append_op("elementwise_max", inputs={"X": [norm.name],
+                                               "Y": [cn.name]},
+                    outputs={"Out": [denom.name]}, attrs={"axis": -1})
+    scale_v = block.create_var(name=unique_name.generate("global_clip_scale"),
+                               shape=(1,), dtype="float32",
+                               stop_gradient=True)
+    block.append_op("elementwise_div", inputs={"X": [cn.name],
+                                               "Y": [denom.name]},
+                    outputs={"Out": [scale_v.name]}, attrs={"axis": -1})
+    out = []
+    for p, g in params_grads:
+        c = _clip_out(block, g)
+        block.append_op("elementwise_mul",
+                        inputs={"X": [g.name], "Y": [scale_v.name]},
+                        outputs={"Out": [c.name]}, attrs={"axis": -1})
+        out.append((p, c))
+    return out

@@ -1,0 +1,252 @@
+"""Executor: whole-block XLA compilation (replaces executor.cc:77's interpreter).
+
+The reference Executor creates scope vars then interprets `OpDesc`s one at a
+time, each op dispatching a device kernel (framework/executor.cc:116,
+operator.cc:461-530).  Here `Executor.run` *lowers the whole block* into a
+single pure JAX function
+
+    (state_written, state_read, feeds, rng_key) -> (fetches, new_state)
+
+jits it once per (program version, feed shapes, place), caches the executable,
+and thereafter each `run` is one XLA invocation: parameters stay resident in
+HBM, optimizer updates are fused into the same program as forward+backward, and
+written state buffers are donated so updates are in-place.  This is the
+"Executor as compiler" stance of SURVEY.md §7 step 3.
+
+Feed/fetch (feed_fetch_method.h in the reference) become the function arguments
+and results; host↔HBM transfer happens only there.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.registry import EmitContext, get_op_info
+from .core import Program, Variable, canonical_dtype, np_dtype
+from .place import Place, default_place
+from .scope import Scope, global_scope
+
+logger = logging.getLogger("paddle_tpu")
+
+# ops the lowerer skips: pure-desc markers with no computation
+_NOOP_TYPES = ("feed", "fetch")
+
+
+class _Compiled:
+    def __init__(self, fn, external_reads, rw_state, written_state, fetch_names):
+        self.fn = fn
+        self.external_reads = external_reads  # read-only state var names
+        self.rw_state = rw_state  # read-then-written: must pre-exist, donated
+        self.written_state = written_state  # all names persisted back to scope
+        self.fetch_names = fetch_names
+
+
+def _fetch_name(f) -> str:
+    return f.name if isinstance(f, Variable) else str(f)
+
+
+def as_numpy(x):
+    return np.asarray(x)
+
+
+class Executor:
+    """fluid.Executor equivalent (python executor.py:70 / pybind.cc:424)."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else default_place()
+        self._cache: Dict[tuple, _Compiled] = {}
+        self._step = 0
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, object]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        block_id: int = 0,
+    ):
+        from .core import default_main_program
+
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
+        scope = scope if scope is not None else global_scope()
+
+        block = program.blocks[block_id]
+        feed_vals = self._prepare_feeds(block, feed)
+
+        key = self._cache_key(program, block_id, feed_vals, fetch_names)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, block_id, feed_vals, fetch_names)
+            self._cache[key] = compiled
+
+        import jax
+
+        state_w = {}
+        for n in compiled.rw_state:
+            v = scope.find(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} used before initialization — run the "
+                    f"startup program first (fluid semantics)"
+                )
+            state_w[n] = v
+        state_r = {}
+        for n in compiled.external_reads:
+            v = scope.find(n)
+            if v is None:
+                bvar = block._find_var_recursive(n)
+                if bvar is not None and bvar.is_data:
+                    raise RuntimeError(
+                        f"data variable {n!r} was not fed — add it to `feed`"
+                    )
+                raise RuntimeError(f"variable {n!r} not initialized in scope")
+            state_r[n] = v
+
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(program.random_seed), self._step
+        )
+        self._step += 1
+
+        with jax.default_device(self.place.jax_device()):
+            fetches, new_state = compiled.fn(state_w, state_r, feed_vals, rng)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [as_numpy(fetches[n]) for n in fetch_names]
+        return [fetches[n] for n in fetch_names]
+
+    # ------------------------------------------------------------------
+    def _prepare_feeds(self, block, feed: Dict[str, object]):
+        out = {}
+        for name, value in feed.items():
+            arr = np.asarray(value)
+            if block.has_var(name):
+                var = block.var(name)
+                if var.dtype is not None:
+                    arr = arr.astype(np_dtype(var.dtype), copy=False)
+            out[name] = arr
+        return out
+
+    def _cache_key(self, program, block_id, feed_vals, fetch_names):
+        feed_sig = tuple(
+            (n, v.shape, str(v.dtype)) for n, v in sorted(feed_vals.items())
+        )
+        return (id(program), program._version, block_id, feed_sig,
+                tuple(fetch_names), self.place)
+
+    # ------------------------------------------------------------------
+    def _analyze(self, block, feed_names):
+        """Static pass over the desc: which names are read from the scope and
+        which scope/persistable names the block writes (params updated by
+        optimizer ops, BN stats, metric states)."""
+        produced = set(feed_names)
+        external_reads: List[str] = []
+        rw_state: List[str] = []
+        written_state: List[str] = []
+        seen_reads = set()
+        for op in block.ops:
+            if op.type in _NOOP_TYPES:
+                continue
+            for n in op.input_names():
+                if n and n not in produced and n not in seen_reads:
+                    seen_reads.add(n)
+                    external_reads.append(n)
+            for n in op.output_names():
+                if not n:
+                    continue
+                # a write to a var that pre-exists outside this run's dataflow
+                # (parameter update, stat update) must persist back to scope
+                if n in seen_reads and n not in rw_state:
+                    rw_state.append(n)
+                    written_state.append(n)
+                produced.add(n)
+        # persistable outputs that were never read still persist (e.g. startup
+        # program initializers writing params fresh)
+        for op in block.ops:
+            if op.type in _NOOP_TYPES:
+                continue
+            for n in op.output_names():
+                if not n or n in written_state:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    written_state.append(n)
+        # reads satisfied by pre-existing state that is also rewritten live on
+        # the donated side only
+        external_reads = [n for n in external_reads if n not in rw_state]
+        return external_reads, rw_state, written_state
+
+    def _compile(self, program, block_id, feed_vals, fetch_names) -> _Compiled:
+        import jax
+
+        block = program.blocks[block_id]
+        feed_names = list(feed_vals.keys())
+        external_reads, rw_state, written_state = self._analyze(block, feed_names)
+        is_test = not any(
+            op.type.endswith("_grad") or op.type == "generic_grad"
+            for op in block.ops
+        )
+
+        def step_fn(state_w, state_r, feeds, rng_key):
+            env = {}
+            env.update(state_r)
+            env.update(state_w)
+            env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
+            ctx = EmitContext(rng_key, is_test=is_test, program=program)
+            ctx.lower_block = lambda idx, sub_env: _lower_ops(
+                program.blocks[idx].ops, sub_env, ctx
+            )
+            _lower_ops(block.ops, env, ctx)
+            fetches = {n: env[n] for n in fetch_names}
+            new_state = {n: env[n] for n in written_state if n in env}
+            return fetches, new_state
+
+        jitted = jax.jit(step_fn, donate_argnums=(0,))
+        logger.debug(
+            "compiled block %d: %d ops, %d reads, %d writes, feeds=%s",
+            block_id, len(block.ops), len(external_reads), len(written_state),
+            feed_names,
+        )
+        return _Compiled(jitted, external_reads, rw_state, written_state,
+                         fetch_names)
+
+    def close(self):
+        self._cache.clear()
+
+
+def _lower_ops(ops, env, ctx):
+    """Trace every op's emitter into the surrounding JAX trace, threading the
+    SSA environment (name → traced array)."""
+    for op in ops:
+        if op.type in _NOOP_TYPES:
+            continue
+        info = get_op_info(op.type)
+        ins = {
+            slot: [env[n] if n else None for n in names]
+            for slot, names in op.inputs.items()
+        }
+        attrs = op.attrs
+        if op.type == "generic_grad":
+            attrs = dict(op.attrs)
+            attrs["__wanted__"] = {
+                (slot[: -len("@GRAD")], i)
+                for slot, names in op.outputs.items()
+                for i, n in enumerate(names)
+                if n
+            }
+        outs = info.emit(ctx, ins, attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, []) if outs else []
+            for i, n in enumerate(names):
+                if not n:
+                    continue
+                if i < len(vals) and vals[i] is not None:
+                    env[n] = vals[i]
+    return env

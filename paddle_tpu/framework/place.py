@@ -1,0 +1,67 @@
+"""Places: device identity (reference paddle/platform/place.h:24-71).
+
+The reference's ``boost::variant<CPUPlace, CUDAPlace>`` becomes CPUPlace/TPUPlace
+backed by JAX devices.  A Place resolves to a concrete ``jax.Device``; the
+executor compiles per-place (XLA:TPU or XLA:CPU), which replaces the reference's
+per-(place,dtype,layout,library) kernel dispatch (operator.cc:461-530).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    def jax_device(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+
+class CPUPlace(Place):
+    def jax_device(self):
+        import jax
+
+        return jax.devices("cpu")[0]
+
+    def __repr__(self):
+        return "CPUPlace()"
+
+
+class TPUPlace(Place):
+    """One accelerator chip. Falls back to the default JAX backend's device
+    `device_id` — under a CPU-only test environment this is a host device, so
+    programs written against TPUPlace still run (the reference's WITH_GPU=OFF
+    stub story, paddle/cuda/include/stub/)."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        import jax
+
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Alias: code ported from the reference may say CUDAPlace; on this framework it
+# means "the accelerator" (TPU).
+CUDAPlace = TPUPlace
+
+
+@functools.lru_cache(maxsize=None)
+def has_accelerator() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+def default_place() -> Place:
+    return TPUPlace(0) if has_accelerator() else CPUPlace()

@@ -1,0 +1,63 @@
+"""Scope: name → value container (reference paddle/framework/scope.h:38).
+
+The reference's Scope holds type-erased Variables with parent-chain lookup; ops
+read/write it imperatively.  Here the Scope only holds *persistent* state
+between executor runs — parameters, optimizer moments, learning-rate tensors,
+metric states — as JAX arrays resident on the place's device.  Transient op
+outputs never materialize: they are values inside the compiled XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self._kids.append(s)
+        return s
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+    def find(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name: str) -> bool:
+        return self.find(name) is not None
+
+    def drop(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_names(self):
+        return list(self._vars.keys())
+
+    def find_np(self, name: str) -> np.ndarray:
+        v = self.find(name)
+        return None if v is None else np.asarray(v)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

@@ -1,0 +1,133 @@
+"""save/load persistables and inference models (reference
+python/paddle/v2/fluid/io.py:111/173/222/301 + operators/save_op.cc:59,
+load_op.cc:22 + framework/prune.cc).
+
+Values stream as .npy files per variable; the program as program.json —
+the TPU-era model format fulfilling doc/design/model_format.md's contract."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .framework.core import Parameter, Program, default_main_program
+from .framework.scope import global_scope
+
+
+def _is_persistable(var) -> bool:
+    return bool(var.persistable)
+
+
+def save_vars(dirname, var_names, scope=None):
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    for name in var_names:
+        val = scope.find(name)
+        if val is None:
+            raise RuntimeError(f"save_vars: {name!r} not in scope")
+        np.save(os.path.join(dirname, name + ".npy"),
+                np.asarray(val), allow_pickle=False)
+
+
+def load_vars(dirname, var_names, scope=None):
+    import jax.numpy as jnp
+
+    scope = scope or global_scope()
+    for name in var_names:
+        path = os.path.join(dirname, name + ".npy")
+        scope.set(name, jnp.asarray(np.load(path)))
+
+
+def persistable_names(program: Optional[Program] = None) -> List[str]:
+    program = program or default_main_program()
+    return [v.name for v in program.global_block().vars.values()
+            if _is_persistable(v)]
+
+
+def save_persistables(executor, dirname, main_program=None, scope=None):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    # persistables = params + optimizer accumulators + BN stats; anything
+    # persistable declared in the program that exists in the scope
+    names = [n for n in persistable_names(program) if scope.has(n)]
+    # optimizer state lives in scope but may only be declared as global vars
+    save_vars(dirname, names, scope)
+    with open(os.path.join(dirname, "persistables.json"), "w") as f:
+        json.dump(names, f)
+
+
+def load_persistables(executor, dirname, main_program=None, scope=None):
+    with open(os.path.join(dirname, "persistables.json")) as f:
+        names = json.load(f)
+    load_vars(dirname, names, scope or global_scope())
+
+
+def prune(program: Program, targets: List[str]) -> Program:
+    """Drop ops not needed to compute `targets` (framework/prune.cc)."""
+    pruned = Program.from_json(program.to_json())
+    block = pruned.global_block()
+    needed = set(targets)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            needed.update(n for n in op.input_names() if n)
+    block.ops = list(reversed(keep))
+    return pruned
+
+
+def _strip_backward(program: Program, targets: List[str]) -> Program:
+    """Remove grad/optimizer ops, keeping the forward subgraph for targets."""
+    fwd = Program.from_json(program.to_json())
+    block = fwd.global_block()
+    block.ops = [
+        op for op in block.ops
+        if op.type not in ("generic_grad",)
+        and not op.type.endswith("_grad")
+        and "@GRAD" not in "".join(op.output_names())
+    ]
+    return prune(fwd, targets)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, scope=None):
+    """io.py:222 equivalent: prune to targets, save program + persistables."""
+    program = main_program or default_main_program()
+    target_names = [t.name if hasattr(t, "name") else t for t in target_vars]
+    inference_program = _strip_backward(program, target_names)
+    # drop train-only modes
+    for op in inference_program.global_block().ops:
+        if op.type in ("dropout", "batch_norm"):
+            op.attrs["is_test"] = True
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed_var_names": list(feeded_var_names),
+        "fetch_var_names": target_names,
+    }
+    with open(os.path.join(dirname, "program.json"), "w") as f:
+        f.write(inference_program.to_json())
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    scope = scope or global_scope()
+    used = set()
+    for op in inference_program.global_block().ops:
+        used.update(op.input_names())
+    names = [n for n in persistable_names(program)
+             if n in used and scope.has(n)]
+    save_vars(dirname, names, scope)
+    with open(os.path.join(dirname, "persistables.json"), "w") as f:
+        json.dump(names, f)
+    return inference_program
+
+
+def load_inference_model(dirname, executor, scope=None):
+    """io.py:301 equivalent → (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, "program.json")) as f:
+        program = Program.from_json(f.read())
+    with open(os.path.join(dirname, "meta.json")) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, scope=scope)
+    return program, meta["feed_var_names"], meta["fetch_var_names"]

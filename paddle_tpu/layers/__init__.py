@@ -1,0 +1,15 @@
+from .nn import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    assign,
+    cast,
+    concat,
+    elementwise_add,
+    elementwise_div,
+    elementwise_mul,
+    elementwise_sub,
+    fill_constant,
+    reshape,
+    scale,
+    sums,
+    transpose,
+)

@@ -1,0 +1,371 @@
+"""Layer functions building ops into the default main program.
+
+The TPU-native counterpart of fluid's python/paddle/v2/fluid/layers/nn.py
+(fc:35, embedding, conv2d, pool2d, batch_norm, dropout...) — same contract
+(append OpDescs + create params via LayerHelper), emitting ops this framework
+lowers to XLA in one piece."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..framework.core import Variable
+from ..framework.initializer import ConstantInitializer, NormalInitializer
+from ..framework.layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """Declare an input (fluid layers/io.py data): prepends batch dim -1."""
+    helper = LayerHelper("data")
+    full_shape = ([-1] + list(shape)) if append_batch_size else list(shape)
+    return helper.block.create_var(
+        name=name,
+        shape=full_shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        stop_gradient=True,
+        is_data=True,
+    )
+
+
+def _shape_prod(shape):
+    p = 1
+    for s in shape:
+        p *= int(s)
+    return p
+
+
+def fc(
+    input: Union[Variable, Sequence[Variable]],
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name=None,
+):
+    """Fully connected (fluid nn.py:35): mul per input + sum + bias + act.
+    Lowered, it is one fused XLA GEMM chain on the MXU."""
+    helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_dims = inp.shape[num_flatten_dims:]
+        w = helper.create_parameter(
+            attr=param_attr if isinstance(param_attr, dict) else {},
+            shape=[_shape_prod(in_dims), size],
+            dtype=inp.dtype,
+        )
+        out = helper.create_tmp_variable(
+            inp.dtype, shape=tuple(inp.shape[:num_flatten_dims]) + (size,)
+        )
+        helper.append_op(
+            "mul",
+            inputs={"X": [inp.name], "Y": [w.name]},
+            outputs={"Out": [out.name]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre = mul_results[0]
+    else:
+        pre = helper.create_tmp_variable(mul_results[0].dtype,
+                                         shape=mul_results[0].shape)
+        helper.append_op("sum", inputs={"X": [m.name for m in mul_results]},
+                         outputs={"Out": [pre.name]})
+    pre = helper.append_bias_op(pre, dim_start=num_flatten_dims)
+    return helper.append_activation(pre)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    """fluid nn.py embedding → lookup_table op. `is_sparse` kept for API
+    parity; under XLA the grad is a scatter-add either way."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(
+        attr=param_attr if isinstance(param_attr, dict) else {},
+        shape=list(size), dtype=dtype,
+    )
+    in_shape = tuple(input.shape[:-1]) if input.shape and input.shape[-1] == 1 \
+        else tuple(input.shape or ())
+    out = helper.create_tmp_variable(dtype, shape=in_shape + (size[1],))
+    helper.append_op(
+        "lookup_table",
+        inputs={"W": [w.name], "Ids": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"is_sparse": bool(is_sparse),
+               "padding_idx": -1 if padding_idx is None else int(padding_idx)},
+    )
+    return out
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    stride = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    padding = padding if isinstance(padding, (list, tuple)) else (
+        padding, padding)
+    dilation = dilation if isinstance(dilation, (list, tuple)) else (
+        dilation, dilation)
+    w = helper.create_parameter(
+        attr=param_attr if isinstance(param_attr, dict) else {},
+        shape=[num_filters, num_channels // groups, fs[0], fs[1]],
+        dtype=input.dtype,
+        default_initializer=NormalInitializer(
+            0.0, (2.0 / (fs[0] * fs[1] * num_channels)) ** 0.5),
+    )
+
+    def _od(i, k, s, p, d):
+        if i is None or i < 0:
+            return -1
+        ke = d * (k - 1) + 1
+        return (i + 2 * p - ke) // s + 1
+
+    oh = _od(input.shape[2], fs[0], stride[0], padding[0], dilation[0])
+    ow = _od(input.shape[3], fs[1], stride[1], padding[1], dilation[1])
+    out = helper.create_tmp_variable(
+        input.dtype, shape=(input.shape[0], num_filters, oh, ow))
+    helper.append_op(
+        "conv2d",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [out.name]},
+        attrs={"strides": list(stride), "paddings": list(padding),
+               "dilations": list(dilation), "groups": groups},
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr if isinstance(bias_attr, dict) else {},
+            shape=[num_filters], dtype=input.dtype, is_bias=True)
+        tmp = helper.create_tmp_variable(out.dtype, shape=out.shape)
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [out.name], "Y": [b.name]},
+            outputs={"Out": [tmp.name]},
+            attrs={"axis": 1},
+        )
+        out = tmp
+    return helper.append_activation(out)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=None,
+            pool_padding=0, global_pooling=False, ceil_mode=False, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    ps = pool_size if isinstance(pool_size, (list, tuple)) else (
+        pool_size, pool_size)
+    st = pool_stride or ps
+    st = st if isinstance(st, (list, tuple)) else (st, st)
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) else (
+        pool_padding, pool_padding)
+
+    def _od(i, k, s, p):
+        if i is None or i < 0:
+            return -1
+        return (i + 2 * p - k) // s + 1
+
+    if global_pooling:
+        oh = ow = 1
+    else:
+        oh = _od(input.shape[2], ps[0], st[0], pd[0])
+        ow = _od(input.shape[3], ps[1], st[1], pd[1])
+    out = helper.create_tmp_variable(
+        input.dtype, shape=(input.shape[0], input.shape[1], oh, ow))
+    helper.append_op(
+        "pool2d",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"pooling_type": pool_type, "ksize": list(ps),
+               "strides": list(st), "paddings": list(pd),
+               "global_pooling": global_pooling},
+    )
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("batch_norm", act=act, name=name)
+    c = input.shape[1]
+    dtype = input.dtype
+    scale = helper.create_parameter(
+        attr=param_attr if isinstance(param_attr, dict) else {},
+        shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(
+        attr=bias_attr if isinstance(bias_attr, dict) else {},
+        shape=[c], dtype=dtype, is_bias=True)
+    mean = helper.create_global_variable(shape=(c,), dtype=dtype)
+    variance = helper.create_global_variable(shape=(c,), dtype=dtype)
+    helper.set_initialized(mean, ConstantInitializer(0.0))
+    helper.set_initialized(variance, ConstantInitializer(1.0))
+    saved_mean = helper.create_tmp_variable(dtype, shape=(c,),
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, shape=(c,),
+                                           stop_gradient=True)
+    out = helper.create_tmp_variable(dtype, shape=input.shape)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input.name], "Scale": [scale.name], "Bias": [bias.name],
+                "Mean": [mean.name], "Variance": [variance.name]},
+        outputs={"Y": [out.name], "MeanOut": [mean.name],
+                 "VarianceOut": [variance.name],
+                 "SavedMean": [saved_mean.name],
+                 "SavedVariance": [saved_var.name]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test},
+    )
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    mask = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                      stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name], "Mask": [mask.name]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "dropout_implementation": dropout_implementation},
+    )
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    norm_shape = [_shape_prod(input.shape[begin_norm_axis:])]
+    ins = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(
+            attr=param_attr if isinstance(param_attr, dict) else {},
+            shape=norm_shape, dtype=input.dtype,
+            default_initializer=ConstantInitializer(1.0))
+        ins["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(
+            attr=bias_attr if isinstance(bias_attr, dict) else {},
+            shape=norm_shape, dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b.name]
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    mean = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "layer_norm", inputs=ins,
+        outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(out)
+
+
+# --- losses / metrics -------------------------------------------------------
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    minus_out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("elementwise_sub",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [minus_out.name]}, attrs={"axis": -1})
+    sq = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("square", inputs={"X": [minus_out.name]},
+                     outputs={"Out": [sq.name]})
+    return sq
+
+
+def cross_entropy(input, label, soft_label=False):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(
+        input.dtype, shape=tuple(input.shape[:-1]) + (1,))
+    helper.append_op(
+        "cross_entropy",
+        inputs={"X": [input.name], "Label": [label.name]},
+        outputs={"Y": [out.name]},
+        attrs={"soft_label": soft_label},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_tmp_variable(logits.dtype, shape=logits.shape)
+    loss = helper.create_tmp_variable(
+        logits.dtype, shape=tuple(logits.shape[:-1]) + (1,))
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": [logits.name], "Label": [label.name]},
+        outputs={"Loss": [loss.name], "Softmax": [softmax.name]},
+        attrs={"soft_label": soft_label},
+    )
+    return loss
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=(1,))
+    helper.append_op("mean", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def softmax(input, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def topk(input, k):
+    helper = LayerHelper("top_k")
+    values = helper.create_tmp_variable(
+        input.dtype, shape=tuple(input.shape[:-1]) + (k,), stop_gradient=True)
+    indices = helper.create_tmp_variable(
+        "int64", shape=tuple(input.shape[:-1]) + (k,), stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [values.name], "Indices": [indices.name]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def accuracy(input, label, k=1):
+    helper = LayerHelper("accuracy")
+    _, indices = topk(input, k)
+    acc = helper.create_tmp_variable("float32", shape=(1,),
+                                     stop_gradient=True)
+    correct = helper.create_tmp_variable("int64", shape=(1,),
+                                         stop_gradient=True)
+    total = helper.create_tmp_variable("int64", shape=(1,),
+                                       stop_gradient=True)
+    helper.append_op(
+        "accuracy",
+        inputs={"Indices": [indices.name], "Label": [label.name]},
+        outputs={"Accuracy": [acc.name], "Correct": [correct.name],
+                 "Total": [total.name]},
+    )
+    return acc
+
+
+def auc(input, label):
+    helper = LayerHelper("auc")
+    out = helper.create_tmp_variable("float32", shape=(1,), stop_gradient=True)
+    helper.append_op("auc",
+                     inputs={"Predict": [input.name], "Label": [label.name]},
+                     outputs={"AUC": [out.name]})
+    return out

@@ -1,0 +1,13 @@
+"""Op library: importing this package registers every emitter.
+
+Reference scale: 189 REGISTER_OP sites (SURVEY.md §2.2). Use
+`registry.registered_ops()` to inventory."""
+
+from . import registry  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from .registry import EmitContext, get_op_info, has_op, register_op, registered_ops  # noqa: F401

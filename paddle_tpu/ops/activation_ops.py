@@ -1,0 +1,108 @@
+"""Activation ops: the reference registers ~29 functors in one generic
+activation_op.cc (SURVEY.md §2.2 'Activations'); same table-driven scheme here,
+each a one-line jnp/jax.nn expression. Grads come from the generic vjp path —
+XLA fuses them into surrounding ops anyway (elementwise = HBM-bandwidth-bound,
+fusion is the whole game on TPU)."""
+
+from __future__ import annotations
+
+import math
+
+from .registry import register_op
+
+
+def _make(fn):
+    def emit(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+
+    return emit
+
+
+def _register_all():
+    import jax
+    import jax.numpy as jnp
+
+    table = {
+        "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+        "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+        "exp": lambda x, a: jnp.exp(x),
+        "relu": lambda x, a: jax.nn.relu(x),
+        "tanh": lambda x, a: jnp.tanh(x),
+        "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+        "softshrink": lambda x, a: jnp.where(
+            x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+            jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)),
+        "hard_shrink": lambda x, a: jnp.where(
+            jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+        "sqrt": lambda x, a: jnp.sqrt(x),
+        "abs": lambda x, a: jnp.abs(x),
+        "ceil": lambda x, a: jnp.ceil(x),
+        "floor": lambda x, a: jnp.floor(x),
+        "round": lambda x, a: jnp.round(x),
+        "reciprocal": lambda x, a: 1.0 / x,
+        "log": lambda x, a: jnp.log(x),
+        "square": lambda x, a: x * x,
+        "softplus": lambda x, a: jax.nn.softplus(x),
+        "softsign": lambda x, a: jax.nn.soft_sign(x),
+        "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+        "leaky_relu": lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)),
+        "soft_relu": lambda x, a: jnp.log(
+            1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                                 a.get("threshold", 40.0)))),
+        "elu": lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)),
+        "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+        "pow": lambda x, a: x ** a.get("factor", 1.0),
+        "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+            a.get("scale_a", 2.0 / 3.0) * x),
+        "thresholded_relu": lambda x, a: jnp.where(
+            x > a.get("threshold", 1.0), x, 0.0),
+        "hard_sigmoid": lambda x, a: jnp.clip(
+            a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+        "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+        "gelu": lambda x, a: jax.nn.gelu(x),
+        "silu": lambda x, a: jax.nn.silu(x),
+    }
+    for name, fn in table.items():
+        register_op(name, _make(fn))
+
+
+_register_all()
+
+ACTIVATIONS = (
+    "sigmoid logsigmoid exp relu tanh tanh_shrink softshrink hard_shrink sqrt "
+    "abs ceil floor round reciprocal log square softplus softsign brelu "
+    "leaky_relu soft_relu elu relu6 pow stanh thresholded_relu hard_sigmoid "
+    "swish gelu silu"
+).split()
+
+
+@register_op("softmax")
+def softmax(ctx, ins, attrs):
+    import jax
+
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=-1)]}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx, ins, attrs):
+    import jax
+
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=-1)]}
+
+
+@register_op("maxout")
+def maxout(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW
+    g = int(attrs["groups"])
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // g, g, h, w), axis=2)]}
+
+
+@register_op("prelu")
+def prelu(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    return {"Out": [jnp.where(x > 0, x, alpha.reshape(-1)[0] * x)]}

@@ -1,0 +1,193 @@
+"""Loss & metric ops (reference operators/: cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, accuracy_op.cc, auc_op.cc, *_loss ops —
+SURVEY.md §2.2 'Losses/metrics')."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("cross_entropy", non_diff_inputs=("Label",))
+def cross_entropy(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [N, D] probabilities
+    label = ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(-1).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        loss = -jnp.log(picked + eps)
+    return {"Y": [loss]}
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    non_diff_inputs=("Label",),
+    non_diff_outputs=("Softmax",),
+)
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(-1).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits", non_diff_inputs=("Label",)
+)
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(x.dtype)
+    loss = jnp.maximum(x, 0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    return {"Out": [loss]}
+
+
+@register_op("log_loss", non_diff_inputs=("Labels",))
+def log_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    p = ins["Predicted"][0]
+    y = ins["Labels"][0]
+    eps = float(attrs.get("epsilon", 1e-7))
+    return {"Loss": [-(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))]}
+
+
+@register_op("hinge_loss", non_diff_inputs=("Labels",))
+def hinge_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0]
+    y = ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * y - 1) * logits)]}
+
+
+@register_op("huber_loss", non_diff_inputs=())
+def huber_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    d = float(attrs.get("delta", 1.0))
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = float(attrs.get("sigma", 1.0))
+    s2 = sigma * sigma
+    d = x - y
+    if ins.get("InsideWeight") and ins["InsideWeight"][0] is not None:
+        d = d * ins["InsideWeight"][0]
+    a = jnp.abs(d)
+    per = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    if ins.get("OutsideWeight") and ins["OutsideWeight"][0] is not None:
+        per = per * ins["OutsideWeight"][0]
+    out = jnp.sum(per.reshape(per.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [d]}
+
+
+@register_op("rank_loss", non_diff_inputs=("Label",))
+def rank_loss(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jax.nn.softplus(d) - label * d]}
+
+
+@register_op("margin_rank_loss", non_diff_inputs=("Label",))
+def margin_rank_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    margin = float(attrs.get("margin", 0.0))
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("modified_huber_loss", non_diff_inputs=("Y",))
+def modified_huber_loss(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    y = ins["Y"][0].astype(x.dtype)
+    z = (2 * y - 1) * x
+    loss = jnp.where(z < -1, -4 * z, jnp.maximum(0.0, 1 - z) ** 2)
+    return {"Out": [loss], "IntermediateVal": [z]}
+
+
+# --- metrics (not differentiated) ------------------------------------------
+
+
+@register_op("accuracy", grad=None)
+def accuracy(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    pred_idx = ins["Indices"][0]  # [N, k] from top_k
+    label = ins["Label"][0].reshape(-1, 1)
+    correct = jnp.any(pred_idx == label, axis=1)
+    n = jnp.asarray([pred_idx.shape[0]], dtype=jnp.int64)
+    c = jnp.sum(correct.astype(jnp.float32))
+    return {
+        "Accuracy": [(c / pred_idx.shape[0]).reshape((1,))],
+        "Correct": [c.astype(jnp.int64).reshape((1,))],
+        "Total": [n],
+    }
+
+
+@register_op("auc", grad=None)
+def auc(ctx, ins, attrs):
+    """Streaming-free batch AUC via rank statistic."""
+    import jax.numpy as jnp
+
+    probs = ins["Predict"][0][:, 1] if ins["Predict"][0].ndim == 2 else ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(probs)
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(1, probs.shape[0] + 1))
+    npos = jnp.sum(label)
+    nneg = label.shape[0] - npos
+    auc_v = (jnp.sum(ranks * label) - npos * (npos + 1) / 2) / jnp.maximum(
+        npos * nneg, 1.0
+    )
+    return {"AUC": [auc_v.reshape((1,))]}
+
+
+@register_op("precision_recall", grad=None)
+def precision_recall(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    idx = ins["Indices"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    ncls = int(attrs["class_number"])
+    pred_1h = (idx[:, None] == jnp.arange(ncls)[None, :])
+    lab_1h = (label[:, None] == jnp.arange(ncls)[None, :])
+    tp = jnp.sum(pred_1h & lab_1h, axis=0).astype(jnp.float32)
+    fp = jnp.sum(pred_1h & ~lab_1h, axis=0).astype(jnp.float32)
+    fn = jnp.sum(~pred_1h & lab_1h, axis=0).astype(jnp.float32)
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    rec = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    return {"BatchMetrics": [macro], "AccumMetrics": [macro]}

@@ -1,0 +1,218 @@
+"""Math / elementwise / reduction ops (reference operators/mul_op.cc,
+matmul_op.cc, elementwise_*_op.cc, sum_op.cc, scale_op.cc, mean_op.cc,
+reduce_op.cc, clip_op.cc, norm ops — SURVEY.md §2.2 'Math/elementwise').
+
+Elementwise ops implement the reference's `axis` broadcast rule
+(elementwise_op_function.h): y's shape aligns to x starting at `axis`
+(default -1 = trailing alignment)."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _j():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _broadcast_y(x, y, axis):
+    if y.ndim == x.ndim:
+        return y
+    if y.ndim > x.ndim:
+        # X is the smaller operand (e.g. scalar-left sugar `2.0 - x`):
+        # numpy-style trailing broadcast handles it; no reshape of Y
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    axis = int(axis)
+    shape = [1] * x.ndim
+    for i in range(y.ndim):
+        shape[axis + i] = y.shape[i]
+    return y.reshape(shape)
+
+
+def _ew(fn):
+    def emit(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return emit
+
+
+for _name, _fn in [
+    ("elementwise_add", lambda x, y: x + y),
+    ("elementwise_sub", lambda x, y: x - y),
+    ("elementwise_mul", lambda x, y: x * y),
+    ("elementwise_div", lambda x, y: x / y),
+    ("elementwise_pow", lambda x, y: x**y),
+]:
+    register_op(_name, _ew(_fn))
+
+
+@register_op("elementwise_max")
+def elementwise_max(ctx, ins, attrs):
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.maximum(x, _broadcast_y(x, y, attrs.get("axis", -1)))]}
+
+
+@register_op("elementwise_min")
+def elementwise_min(ctx, ins, attrs):
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.minimum(x, _broadcast_y(x, y, attrs.get("axis", -1)))]}
+
+
+@register_op("mul")
+def mul(ctx, ins, attrs):
+    """Flattening matmul (reference mul_op.cc): X flattened to 2-D at
+    x_num_col_dims, Y at y_num_col_dims. The single most important op for the
+    MXU — large 2-D bf16 GEMMs."""
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = int(attrs.get("x_num_col_dims", 1))
+    ync = int(attrs.get("y_num_col_dims", 1))
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((-1, int(_prod(xs[xnc:]))))
+    y2 = y.reshape((int(_prod(ys[:ync])), -1))
+    out = x2 @ y2
+    return {"Out": [out.reshape(xs[:xnc] + ys[ync:])]}
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+@register_op("matmul")
+def matmul(ctx, ins, attrs):
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X"):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y"):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("sum")
+def sum_op(ctx, ins, attrs):
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("scale")
+def scale(ctx, ins, attrs):
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [ins["X"][0] * s + b]}
+    return {"Out": [(ins["X"][0] + b) * s]}
+
+
+@register_op("mean")
+def mean(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.mean(ins["X"][0]).reshape((1,))]}
+
+
+def _reduce(fn):
+    def emit(ctx, ins, attrs):
+        x = ins["X"][0]
+        dim = attrs.get("dim", None)
+        keep = bool(attrs.get("keep_dim", False))
+        if attrs.get("reduce_all", False) or dim is None:
+            axis = None
+        else:
+            axis = tuple(dim) if isinstance(dim, (list, tuple)) else int(dim)
+        return {"Out": [fn(x, axis, keep)]}
+
+    return emit
+
+
+def _register_reduces():
+    jnp_ops = {
+        "reduce_sum": lambda x, a, k: _j().sum(x, axis=a, keepdims=k),
+        "reduce_mean": lambda x, a, k: _j().mean(x, axis=a, keepdims=k),
+        "reduce_max": lambda x, a, k: _j().max(x, axis=a, keepdims=k),
+        "reduce_min": lambda x, a, k: _j().min(x, axis=a, keepdims=k),
+        "reduce_prod": lambda x, a, k: _j().prod(x, axis=a, keepdims=k),
+    }
+    for name, fn in jnp_ops.items():
+        register_op(name, _reduce(fn))
+
+
+_register_reduces()
+
+
+@register_op("minus")
+def minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("sign")
+def sign(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("clip")
+def clip(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    mn = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": [jnp.where(norm > mn, x * (mn / norm), x)]}
+
+
+@register_op("l1_norm")
+def l1_norm(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x).reshape((1,))]}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx, ins, attrs):
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    sub = d.reshape((d.shape[0], -1))
+    return {
+        "Out": [jnp.sum(sub * sub, axis=1, keepdims=True)],
+        "sub_result": [d],
+    }
+
+
+@register_op("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    jnp = _j()
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    out = jnp.sum(x * y, axis=1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}

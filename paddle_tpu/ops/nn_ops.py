@@ -1,0 +1,243 @@
+"""NN layer ops: conv, pool, batch_norm, dropout, lrn, layer_norm...
+
+Reference: operators/conv_op.cc (+conv_cudnn_op.cu), pool_op.cc,
+batch_norm_op.cc, dropout_op.cc, lrn_op.cc (SURVEY.md §2.2 'NN layers').
+cuDNN-specific kernel variants collapse: lax.conv_general_dilated /
+lax.reduce_window lower straight onto the MXU / VPU. Layout stays NCHW at the
+IR level (the reference's contract); XLA re-lays-out internally for TPU."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v), int(v)]
+
+
+@register_op("conv2d")
+def conv2d(ctx, ins, attrs):
+    import jax
+
+    x = ins["Input"][0]  # NCHW
+    w = ins["Filter"][0]  # OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["groups"] = ins["Input"][0].shape[1]
+    return conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    import jax
+
+    x = ins["Input"][0]
+    w = ins["Filter"][0]  # IOHW in paddle conv_transpose
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = ksize
+        pads = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4,
+                                        padding)
+            out = out / cnt
+        else:
+            out = out / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op("batch_norm", non_diff_outputs=("MeanOut", "VarianceOut",
+                                             "SavedMean", "SavedVariance"))
+def batch_norm(ctx, ins, attrs):
+    """Reference batch_norm_op.cc. Train mode: batch stats + running-stat
+    update (MeanOut/VarianceOut alias the Mean/Variance state vars, persisted
+    by the executor's written-state logic). Test mode: running stats."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW or NC
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = float(attrs.get("epsilon", 1e-5))
+    momentum = float(attrs.get("momentum", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    shape = [1] * x.ndim
+    shape[1] = x.shape[1]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean, saved_var = mean, var
+    else:
+        f32 = x.astype(jnp.float32)
+        use_mean = jnp.mean(f32, axis=axes)
+        use_var = jnp.var(f32, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean.astype(mean.dtype)
+        var_out = momentum * var + (1 - momentum) * use_var.astype(var.dtype)
+        saved_mean, saved_var = use_mean, use_var
+
+    inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
+    xhat = (x.astype(jnp.float32) - use_mean.reshape(shape)) * inv.reshape(shape)
+    y = (xhat * scale.reshape(shape) + bias.reshape(shape)).astype(x.dtype)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    eps = float(attrs.get("epsilon", 1e-5))
+    begin = int(attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xhat = (x - mean) / jnp.sqrt(var + eps)
+    y = xhat
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0]
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0]
+    return {"Y": [y], "Mean": [mean.reshape(-1)], "Variance": [var.reshape(-1)]}
+
+
+@register_op("dropout", non_diff_outputs=("Mask",))
+def dropout(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    p = float(attrs.get("dropout_prob", 0.5))
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if bool(attrs.get("is_test", False)) or ctx.is_test or p == 0.0:
+        # reference dropout_op.h:60: downgrade_in_infer scales by (1-p) at
+        # inference; upscale_in_train is identity at inference
+        out = x if (impl == "upscale_in_train" or p == 0.0) else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones_like(x)]}
+    key = ctx.rng(attrs)
+    mask = (jax.random.uniform(key, x.shape) >= p).astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = x * mask / (1.0 - p)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_op("lrn")
+def lrn(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW
+    n = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    half = n // 2
+    sq = x * x
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / mid**beta], "MidOut": [mid]}
+
+
+@register_op("im2sequence")
+def im2sequence(ctx, ins, attrs):
+    import jax
+
+    x = ins["X"][0]
+    kernels = _pair(attrs["kernels"])
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=kernels, window_strides=strides,
+        padding=[(pads[0], pads[2] if len(pads) > 2 else pads[0]),
+                 (pads[1], pads[3] if len(pads) > 3 else pads[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ck, oh, ow = patches.shape
+    return {"Out": [patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ck)]}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]  # w: [out, dx, dy]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("row_conv")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution over [batch, time, dim] (reference
+    row_conv_op.cc operates on LoD; here the padded-batch form)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]  # [future_context+1, D]
+    ctx_len = w.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    out = sum(pad[:, i : i + x.shape[1]] * w[i] for i in range(ctx_len))
+    return {"Out": [out]}

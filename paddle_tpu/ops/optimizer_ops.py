@@ -1,0 +1,193 @@
+"""Optimizer ops: parameter updates as graph ops, one step per minibatch
+(reference operators/sgd_op.cc, momentum_op.cc, adam_op.cc, adagrad_op.cc,
+adadelta_op.cc, adamax_op.cc, rmsprop_op.cc, ftrl_op.cc, decayed_adagrad_op.cc,
+proximal_*_op.cc — SURVEY.md §2.2 'Optimizer ops').
+
+On TPU these fuse into the same XLA program as forward+backward, so a whole
+training step is one device launch; `ParamOut` aliases `Param` and the executor
+donates the buffers, making updates genuinely in-place in HBM."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register_op("sgd", grad=None)
+def sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr.reshape(()) * g.astype(p.dtype)]}
+
+
+@register_op("momentum", grad=None)
+def momentum(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    v = ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    mu = float(attrs["mu"])
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam", grad=None)
+def adam(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = g.astype(jnp.float32)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - (lr_t * m_out / (jnp.sqrt(v_out) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out]}
+
+
+@register_op("adam_beta_pow_update", grad=None)
+def adam_beta_pow_update(ctx, ins, attrs):
+    """Advance Beta1Pow/Beta2Pow accumulators (the reference does this inside
+    python optimizer.py's _finish_update via scale ops)."""
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    return {
+        "Beta1PowOut": [b1p * float(attrs["beta1"])],
+        "Beta2PowOut": [b2p * float(attrs["beta2"])],
+    }
+
+
+@register_op("adamax", grad=None)
+def adamax(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (inf_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register_op("adagrad", grad=None)
+def adagrad(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    eps = float(attrs.get("epsilon", 1e-6))
+    m_out = m + g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("decayed_adagrad", grad=None)
+def decayed_adagrad(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    decay = float(attrs.get("decay", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("adadelta", grad=None)
+def adadelta(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = float(attrs.get("rho", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    sq_out = rho * avg_sq + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_upd + eps) / (sq_out + eps)) * g
+    upd_out = rho * avg_upd + (1 - rho) * upd * upd
+    return {
+        "ParamOut": [p + upd],
+        "AvgSquaredGradOut": [sq_out],
+        "AvgSquaredUpdateOut": [upd_out],
+    }
+
+
+@register_op("rmsprop", grad=None)
+def rmsprop(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    rho = float(attrs.get("decay", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    mu = float(attrs.get("momentum", 0.0))
+    ms_out = rho * ms + (1 - rho) * g * g
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+            "MomentOut": [mom_out]}
+
+
+@register_op("ftrl", grad=None)
+def ftrl(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    power = float(attrs.get("lr_power", -0.5))
+    new_sq = sq + g * g
+    sigma = (new_sq**-power - sq**-power) / lr
+    lin_out = lin + g - sigma * p
+    quad = new_sq**-power / lr + 2 * l2
+    p_out = jnp.where(
+        jnp.abs(lin_out) > l1,
+        (l1 * jnp.sign(lin_out) - lin_out) / quad,
+        jnp.zeros_like(p),
+    )
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("proximal_gd", grad=None)
+def proximal_gd(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    prox = p - lr * g
+    p_out = (
+        jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+        / (1.0 + lr * l2)
+    )
+    return {"ParamOut": [p_out]}
+
+
+@register_op("proximal_adagrad", grad=None)
+def proximal_adagrad(ctx, ins, attrs):
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    m_out = m + g * g
+    lr_t = lr / _jnp().sqrt(m_out)
+    prox = p - lr_t * g
+    p_out = (
+        jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
+        / (1.0 + lr_t * l2)
+    )
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}

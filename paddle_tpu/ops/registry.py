@@ -1,0 +1,234 @@
+"""Op registry: type → (JAX emitter, grad maker).
+
+The reference registers ~190 ops into OpInfoMap (paddle/framework/op_registry.h:62,
+op_info.h), each with a creator, per-(place,dtype,layout,library) kernels
+(paddle/framework/operator.h:356), and a GradOpDescMaker
+(paddle/framework/grad_op_desc_maker.h).  Here an op is a single *emitter*:
+
+    emit(ctx, ins, attrs) -> outs
+
+where ``ins``/``outs`` map slot name → list of JAX arrays.  One emitter serves
+every place/dtype — XLA generates the device code, replacing the whole
+paddle/cuda + operators/*.cu kernel corpus (SURVEY.md §2.10).
+
+Desc-level autodiff keeps the reference's shape (backward.cc:353 MakeOpGrad): a
+grad *maker* turns a forward OpDesc into grad OpDescs appended to the block.
+The default maker builds one ``<type>_grad`` op carrying the forward op's
+inputs/outputs/attrs; the default grad *emitter* re-traces the forward emitter
+under ``jax.vjp`` and applies the output cotangents.  The recomputed forward
+subgraph is CSE'd/fused by XLA (or acts as rematerialization, which is usually a
+win on TPU where HBM bandwidth, not FLOPs, is the bottleneck).  Ops that want a
+cheaper analytic backward (using their saved outputs) register a custom grad
+emitter; stateful/optimizer ops register ``grad=None``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class OpInfo:
+    type: str
+    emit: Callable
+    # grad maker: fn(op, requires_grad: set[str]) -> list of (type, ins, outs, attrs)
+    # "default" → generic vjp-based grad; None → non-differentiable / stateful.
+    grad: Optional[object] = "default"
+    # slots whose values are integral / non-differentiable even if float
+    non_diff_inputs: tuple = ()
+    # output slots never given cotangents (e.g. saved state, masks, indices)
+    non_diff_outputs: tuple = ()
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register_op(type: str, emit: Callable = None, **kw):
+    """Register an op emitter. Usable as decorator or direct call."""
+
+    def _do(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} registered twice")
+        _REGISTRY[type] = OpInfo(type=type, emit=fn, **kw)
+        return fn
+
+    if emit is not None:
+        return _do(emit)
+    return _do
+
+
+def get_op_info(type: str) -> OpInfo:
+    if type not in _REGISTRY:
+        raise KeyError(
+            f"no emitter registered for op {type!r} "
+            f"(registered: {sorted(_REGISTRY)[:20]}...)"
+        )
+    return _REGISTRY[type]
+
+
+def has_op(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Emit context
+
+
+class EmitContext:
+    """Per-lowering state handed to emitters: RNG derivation, train/test mode,
+    and program access for ops with sub-blocks (while/cond — AttrType.BLOCK)."""
+
+    def __init__(self, key, is_test: bool, program=None, lower_block=None):
+        self.key = key
+        self.is_test = is_test
+        self.program = program
+        # callable(block_idx, env) -> env  provided by the executor so control
+        # flow ops can lower nested blocks
+        self.lower_block = lower_block
+
+    def rng(self, attrs) -> "object":
+        """Deterministic per-op PRNG key: base key folded with the op's uid.
+
+        Forward and generic-grad re-trace derive the same key, so stochastic
+        ops (dropout, uniform_random) replay identically in backward."""
+        import jax
+
+        uid = int(attrs.get("__uid__", 0))
+        return jax.random.fold_in(self.key, uid)
+
+
+# ---------------------------------------------------------------------------
+# Generic grad: maker + emitter
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def default_grad_maker(op, requires_grad):
+    """Build one `<type>_grad` op desc from a forward op desc.
+
+    Inputs: forward inputs under their slots, forward outputs under theirs,
+    plus `<slot>@GRAD` for each forward output.  Outputs: `<slot>@GRAD` per
+    forward input slot, with "" placeholders for vars not requiring grad.
+    Mirrors the structure DefaultGradOpDescMaker produces in the reference
+    (grad_op_desc_maker.h)."""
+    info = get_op_info(op.type)
+    ins = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = list(names)
+    for slot, names in op.outputs.items():
+        if slot in ins:
+            raise ValueError(
+                f"op {op.type}: output slot {slot} collides with input slot"
+            )
+        ins[slot] = list(names)
+        ins[slot + GRAD_SUFFIX] = [n + GRAD_SUFFIX for n in names]
+    outs = {}
+    any_grad = False
+    for slot, names in op.inputs.items():
+        if slot in info.non_diff_inputs:
+            continue
+        grads = []
+        for n in names:
+            if n in requires_grad:
+                grads.append(n + GRAD_SUFFIX)
+                any_grad = True
+            else:
+                grads.append("")
+        outs[slot + GRAD_SUFFIX] = grads
+    if not any_grad:
+        return []
+    attrs = {
+        "__fwd_type__": op.type,
+        "__fwd_attrs__": dict(op.attrs),
+        "__fwd_input_slots__": sorted(op.inputs.keys()),
+        "__fwd_output_slots__": sorted(op.outputs.keys()),
+        "__uid__": op.attrs.get("__uid__", 0),
+    }
+    return [("generic_grad", ins, outs, attrs)]
+
+
+def _is_float_dtype(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return isinstance(x, float)
+    s = str(dt)
+    return s.startswith("float") or s in ("bfloat16", "float16")
+
+
+def _generic_grad_emit(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    fwd_type = attrs["__fwd_type__"]
+    fwd_attrs = attrs["__fwd_attrs__"]
+    in_slots = attrs["__fwd_input_slots__"]
+    out_slots = attrs["__fwd_output_slots__"]
+    info = get_op_info(fwd_type)
+
+    fwd_ins = {s: list(ins.get(s, [])) for s in in_slots}
+
+    # Which (slot, idx) to differentiate: grad op's *requested* outputs.
+    diff_pos = []
+    for s in in_slots:
+        if s in info.non_diff_inputs:
+            continue
+        for i, v in enumerate(fwd_ins[s]):
+            # requested iff the grad op declares a non-"" output there; the
+            # executor passes that request via attrs["__wanted__"].
+            if (s, i) in attrs["__wanted__"] and _is_float_dtype(v):
+                diff_pos.append((s, i))
+
+    diff_vals = [fwd_ins[s][i] for s, i in diff_pos]
+
+    def fwd_fn(diff_flat):
+        full = {s: list(vs) for s, vs in fwd_ins.items()}
+        for (s, i), v in zip(diff_pos, diff_flat):
+            full[s][i] = v
+        outs = info.emit(ctx, full, fwd_attrs)
+        flat = []
+        for s in out_slots:
+            for o in outs.get(s, []):
+                flat.append(o)
+        return flat
+
+    primal_outs, vjp_fn = jax.vjp(fwd_fn, diff_vals)
+
+    # Cotangents: grad inputs `<slot>@GRAD`; missing / non-diff outputs → zeros.
+    cts = []
+    k = 0
+    for s in out_slots:
+        n_out = len(ins.get(s, []))
+        grads = ins.get(s + GRAD_SUFFIX, [])
+        for i in range(n_out):
+            primal = primal_outs[k]
+            if (
+                s in info.non_diff_outputs
+                or i >= len(grads)
+                or grads[i] is None
+                or not _is_float_dtype(primal)
+            ):
+                cts.append(jnp.zeros_like(primal))
+            else:
+                cts.append(grads[i].astype(primal.dtype))
+            k += 1
+    (din_flat,) = vjp_fn(cts)
+
+    out = {}
+    for (s, i), g in zip(diff_pos, din_flat):
+        out.setdefault(s + GRAD_SUFFIX, {})[i] = g
+    # densify: executor zips by position; unrequested slots simply absent
+    result = {}
+    for s_grad, by_idx in out.items():
+        n = max(by_idx) + 1
+        result[s_grad] = [by_idx.get(i) for i in range(n)]
+    return result
+
+
+register_op("generic_grad", _generic_grad_emit, grad=None)

@@ -1,0 +1,279 @@
+"""Tensor creation & plumbing ops (reference operators/: fill_constant,
+uniform_random, gaussian_random, cast, concat, split, reshape, transpose,
+expand, gather, scatter, pad, assign, top_k, ... — SURVEY.md §2.2 'Tensor
+plumbing')."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import np_dtype
+from .registry import register_op
+
+
+def _j():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register_op("fill_constant", grad=None)
+def fill_constant(ctx, ins, attrs):
+    jnp = _j()
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register_op("fill_constant_batch_size_like", grad=None)
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = x.shape[in_idx]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register_op("fill_zeros_like", grad=None)
+def fill_zeros_like(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("uniform_random", grad=None)
+def uniform_random(ctx, ins, attrs):
+    import jax
+
+    jnp = _j()
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    lo = float(attrs.get("min", -1.0))
+    hi = float(attrs.get("max", 1.0))
+    key = ctx.rng(attrs)
+    return {"Out": [jax.random.uniform(key, shape, dtype=jnp.float32,
+                                       minval=lo, maxval=hi).astype(dt)]}
+
+
+@register_op("gaussian_random", grad=None)
+def gaussian_random(ctx, ins, attrs):
+    import jax
+
+    jnp = _j()
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    key = ctx.rng(attrs)
+    return {"Out": [(mean + std * jax.random.normal(key, shape, dtype=jnp.float32)
+                     ).astype(dt)]}
+
+
+@register_op("truncated_gaussian_random", grad=None)
+def truncated_gaussian_random(ctx, ins, attrs):
+    import jax
+
+    jnp = _j()
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    key = ctx.rng(attrs)
+    # truncated to 2 std, matching the reference op's semantics
+    x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+    return {"Out": [(mean + std * x).astype(dt)]}
+
+
+@register_op("assign")
+def assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("cast")
+def cast(ctx, ins, attrs):
+    return {"Out": [ins["X"][0].astype(np_dtype(attrs["out_dtype"]))]}
+
+
+@register_op("shape", grad=None)
+def shape_op(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int64)]}
+
+
+@register_op("concat")
+def concat(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.concatenate(ins["X"], axis=int(attrs.get("axis", 0)))]}
+
+
+@register_op("split")
+def split(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    if attrs.get("sections"):
+        idx = np.cumsum(attrs["sections"])[:-1].tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, int(attrs["num"]), axis=axis)
+    return {"Out": list(parts)}
+
+
+@register_op("reshape")
+def reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    # paddle semantics: 0 keeps the input dim, -1 infers
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape[: x.ndim])] + [
+        s for s in shape[x.ndim:]
+    ]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register_op("squeeze")
+def squeeze(ctx, ins, attrs):
+    jnp = _j()
+    axes = tuple(attrs.get("axes", ()))
+    x = ins["X"][0]
+    return {"Out": [jnp.squeeze(x, axis=axes if axes else None)]}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    for ax in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": [x]}
+
+
+@register_op("transpose")
+def transpose(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.transpose(ins["X"][0], axes=attrs["axis"])]}
+
+
+@register_op("expand")
+def expand(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    times = [int(t) for t in attrs["expand_times"]]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("pad")
+def pad(ctx, ins, attrs):
+    jnp = _j()
+    x = ins["X"][0]
+    p = attrs["paddings"]  # flat [lo0, hi0, lo1, hi1, ...]
+    pw = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pw, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("crop")
+def crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    idx = tuple(slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("gather", non_diff_inputs=("Index",))
+def gather(ctx, ins, attrs):
+    jnp = _j()
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, index.astype(jnp.int32), axis=0)]}
+
+
+@register_op("scatter", non_diff_inputs=("Ids",))
+def scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    return {"Out": [x.at[ids].set(updates)]}
+
+
+@register_op("sequence_mask", grad=None)
+def sequence_mask(ctx, ins, attrs):
+    """lengths [N] -> mask [N, maxlen] (static maxlen attr)."""
+    jnp = _j()
+    lengths = ins["X"][0]
+    maxlen = int(attrs["maxlen"])
+    dt = np_dtype(attrs.get("out_dtype", "float32"))
+    rng = jnp.arange(maxlen)
+    return {"Y": [(rng[None, :] < lengths[:, None]).astype(dt)]}
+
+
+@register_op("top_k", grad=None)
+def top_k(ctx, ins, attrs):
+    import jax
+
+    jnp = _j()
+    x = ins["X"][0]
+    k = int(attrs["k"])
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("multiplex", non_diff_inputs=("Ids",))
+def multiplex(ctx, ins, attrs):
+    jnp = _j()
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [n_candidates, batch, ...]
+    return {"Out": [stacked[ids, jnp.arange(ids.shape[0])]]}
+
+
+@register_op("one_hot", grad=None)
+def one_hot(ctx, ins, attrs):
+    import jax
+
+    jnp = _j()
+    x = ins["X"][0].reshape(-1).astype(jnp.int32)
+    depth = int(attrs["depth"])
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register_op("arg_max", grad=None)
+def arg_max(ctx, ins, attrs):
+    jnp = _j()
+    return {"Out": [jnp.argmax(ins["X"][0], axis=int(attrs.get("axis", -1)))
+                    .astype(jnp.int64)]}
+
+
+@register_op("slice")
+def slice_op(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = slice(int(s), int(e))
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("lookup_table", non_diff_inputs=("Ids",))
+def lookup_table(ctx, ins, attrs):
+    """Embedding lookup (reference operators/lookup_table_op.cc; sparse
+    SelectedRows grads become dense segment-sum scatters under XLA — the
+    generic vjp produces exactly a scatter-add)."""
+    jnp = _j()
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    if attrs.get("padding_idx") is not None and attrs.get("padding_idx", -1) >= 0:
+        pad = int(attrs["padding_idx"])
+        emb = jnp.take(w, flat, axis=0)
+        emb = jnp.where((flat == pad)[:, None], 0.0, emb)
+    else:
+        emb = jnp.take(w, flat, axis=0)
+    out_shape = tuple(ids.shape[:-1] if ids.shape[-1] == 1 else ids.shape) + (
+        w.shape[-1],
+    )
+    return {"Out": [emb.reshape(out_shape)]}
+
+
+@register_op("increment")
+def increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}

@@ -1,0 +1,292 @@
+"""Optimizers: append update ops per parameter (reference
+python/paddle/v2/fluid/optimizer.py:150 create_optimization_pass / :203
+minimize). The update ops land in the same block as forward+backward, so the
+whole training step compiles to one XLA program — grads never leave HBM."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.core import default_startup_program
+from .framework.initializer import ConstantInitializer
+from .framework.layer_helper import LayerHelper
+
+
+class Optimizer:
+    op_type = None
+
+    def __init__(self, learning_rate=0.001, regularization=None,
+                 global_clip_norm=None):
+        self._lr_value = learning_rate
+        self.regularization = regularization
+        self.global_clip_norm = global_clip_norm
+        self._accumulators: Dict[str, Dict[str, object]] = {}
+        self.helper = None
+
+    # ------------------------------------------------------------------
+    def _create_lr_var(self, block):
+        self.helper = LayerHelper(type(self).__name__.lower())
+        lr = self.helper.create_global_variable(
+            name=unique_name.generate("learning_rate"),
+            shape=(1,), dtype="float32")
+        self.helper.set_initialized(
+            lr, ConstantInitializer(float(self._lr_value)))
+        self._lr_var = lr
+        return lr
+
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        acc = self.helper.create_global_variable(
+            name=unique_name.generate(f"{param.name}_{name}"),
+            shape=shape or param.shape,
+            dtype=dtype or "float32")
+        self.helper.set_initialized(acc, ConstantInitializer(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = acc
+        return acc
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # subclass hooks ----------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block):
+        pass
+
+    # ------------------------------------------------------------------
+    def _apply_regularization_and_clip(self, block, params_grads):
+        from .regularizer import append_regularization_ops
+        from . import clip as clip_mod
+
+        # reference order (fluid optimizer.py:216-219): clip first, then add
+        # weight decay — decay must not be scaled down by the clip
+        if self.global_clip_norm is not None:
+            params_grads = clip_mod.append_gradient_clip_by_global_norm(
+                block, params_grads, self.global_clip_norm)
+        params_grads = append_regularization_ops(
+            block, params_grads, self.regularization)
+        return params_grads
+
+    def create_optimization_pass(self, params_grads, loss):
+        block = loss.block
+        self._create_lr_var(block)
+        params_grads = self._apply_regularization_and_clip(block, params_grads)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for pg in params_grads:
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block)
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        ops = self.create_optimization_pass(params_grads, loss)
+        return ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "Velocity": [v.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+        self._beta1_pow = self.helper.create_global_variable(
+            name=unique_name.generate("beta1_pow"), shape=(1,),
+            dtype="float32")
+        self.helper.set_initialized(
+            self._beta1_pow, ConstantInitializer(self._beta1))
+        self._beta2_pow = self.helper.create_global_variable(
+            name=unique_name.generate("beta2_pow"), shape=(1,),
+            dtype="float32")
+        self.helper.set_initialized(
+            self._beta2_pow, ConstantInitializer(self._beta2))
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        return block.append_op(
+            "adam",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "Moment1": [m1.name], "Moment2": [m2.name],
+                    "LearningRate": [self._lr_var.name],
+                    "Beta1Pow": [self._beta1_pow.name],
+                    "Beta2Pow": [self._beta2_pow.name]},
+            outputs={"ParamOut": [p.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block):
+        block.append_op(
+            "adam_beta_pow_update",
+            inputs={"Beta1Pow": [self._beta1_pow.name],
+                    "Beta2Pow": [self._beta2_pow.name]},
+            outputs={"Beta1PowOut": [self._beta1_pow.name],
+                     "Beta2PowOut": [self._beta2_pow.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+        self._beta1_pow = self.helper.create_global_variable(
+            name=unique_name.generate("beta1_pow"), shape=(1,),
+            dtype="float32")
+        self.helper.set_initialized(
+            self._beta1_pow, ConstantInitializer(self._beta1))
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        n = self._get_accumulator("inf_norm", p)
+        return block.append_op(
+            "adamax",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "InfNorm": [n.name],
+                    "LearningRate": [self._lr_var.name],
+                    "Beta1Pow": [self._beta1_pow.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name],
+                     "InfNormOut": [n.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block):
+        block.append_op(
+            "scale", inputs={"X": [self._beta1_pow.name]},
+            outputs={"Out": [self._beta1_pow.name]},
+            attrs={"scale": self._beta1},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum_acc", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum_acc", p)
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "MeanSquare": [ms.name], "Moment": [mom.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MeanSquareOut": [ms.name],
+                     "MomentOut": [mom.name]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum},
+        )
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer

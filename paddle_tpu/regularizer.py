@@ -1,0 +1,71 @@
+"""Weight-decay regularizers appended as grad-modifying ops (reference
+python/paddle/v2/fluid/regularizer.py — L2DecayRegularizer appends scale+sum
+ops onto the gradient)."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+
+
+class WeightDecayRegularizer:
+    def append_ops(self, block, param, grad):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_ops(self, block, param, grad):
+        decay = block.create_var(
+            name=unique_name.generate(param.name + "_l2decay"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("scale", inputs={"X": [param.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(
+            name=unique_name.generate(grad.name + "_reg"),
+            shape=grad.shape, dtype=grad.dtype, stop_gradient=True)
+        block.append_op("sum", inputs={"X": [grad.name, decay.name]},
+                        outputs={"Out": [out.name]})
+        return out
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_ops(self, block, param, grad):
+        sign = block.create_var(
+            name=unique_name.generate(param.name + "_sign"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("sign", inputs={"X": [param.name]},
+                        outputs={"Out": [sign.name]})
+        decay = block.create_var(
+            name=unique_name.generate(param.name + "_l1decay"),
+            shape=param.shape, dtype=param.dtype, stop_gradient=True)
+        block.append_op("scale", inputs={"X": [sign.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(
+            name=unique_name.generate(grad.name + "_reg"),
+            shape=grad.shape, dtype=grad.dtype, stop_gradient=True)
+        block.append_op("sum", inputs={"X": [grad.name, decay.name]},
+                        outputs={"Out": [out.name]})
+        return out
+
+
+def append_regularization_ops(block, params_grads, global_regularization=None):
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or global_regularization
+        if reg is None:
+            out.append((p, g))
+        else:
+            new_g = reg.append_ops(block, p, g)
+            out.append((p, new_g))
+    return out
+
+
+L2Decay = L2DecayRegularizer
+L1Decay = L1DecayRegularizer

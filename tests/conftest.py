@@ -1,0 +1,21 @@
+"""Test env: 8 virtual CPU devices — the 'fake cluster' (SURVEY.md §4's
+upgrade over the reference's in-process loopback/notest_dist tricks)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    import paddle_tpu
+
+    paddle_tpu.reset()
+    yield

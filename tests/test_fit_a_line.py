@@ -1,0 +1,52 @@
+"""Acceptance test 1: linear regression trains (reference
+fluid/tests/book/test_fit_a_line.py — passes when avg_cost < 10)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _make_data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, size=(13, 1)).astype(np.float32)
+    b = 0.5
+    x = rng.uniform(-1, 1, size=(n, 13)).astype(np.float32)
+    y = x @ w + b + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def test_fit_a_line():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    sgd = fluid.optimizer.SGD(learning_rate=0.05)
+    sgd.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    xs, ys = _make_data()
+    bs = 64
+    losses = []
+    for epoch in range(30):
+        for i in range(0, len(xs), bs):
+            (loss,) = exe.run(
+                feed={"x": xs[i : i + bs], "y": ys[i : i + bs]},
+                fetch_list=[avg_cost],
+            )
+        losses.append(float(loss))
+    assert losses[-1] < 0.1, f"did not converge: {losses[::5]}"
+    assert losses[-1] < losses[0]
+
+
+def test_program_serialization_roundtrip():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1)
+    prog = fluid.default_main_program()
+    clone = fluid.Program.from_json(prog.to_json())
+    assert clone.num_ops() == prog.num_ops()
+    assert set(clone.global_block().vars) == set(prog.global_block().vars)
