@@ -277,6 +277,19 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 # --- losses / metrics -------------------------------------------------------
 
 
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": alpha},
+    )
+    return out
+
+
 def square_error_cost(input, label):
     helper = LayerHelper("square_error_cost")
     minus_out = helper.create_tmp_variable(input.dtype, shape=input.shape)

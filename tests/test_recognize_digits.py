@@ -1,0 +1,107 @@
+"""Acceptance test 2: MNIST-style digit recognition (reference
+fluid/tests/book/test_recognize_digits_{mlp,conv}.py) — synthetic separable
+data; passes when accuracy climbs well above chance."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import nets
+
+
+def _synthetic_digits(n=512, seed=0):
+    """10 classes, each a distinct 28x28 template + noise."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, size=n)
+    imgs = templates[labels] + 0.3 * rng.rand(n, 1, 28, 28).astype(np.float32)
+    return imgs.astype(np.float32), labels.reshape(n, 1).astype(np.int64)
+
+
+def _train(avg_cost, acc, epochs=6, bs=64, lr_opt=None):
+    opt = lr_opt or fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = _synthetic_digits()
+    accs = []
+    for _ in range(epochs):
+        for i in range(0, len(xs), bs):
+            out = exe.run(
+                feed={"img": xs[i : i + bs], "label": ys[i : i + bs]},
+                fetch_list=[avg_cost, acc],
+            )
+        accs.append(float(out[1].item()))
+    return accs
+
+
+def test_recognize_digits_mlp():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    flat = fluid.layers.reshape(img, [-1, 784])
+    h1 = fluid.layers.fc(input=flat, size=64, act="relu")
+    h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+    logits = fluid.layers.fc(input=h2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = fluid.layers.mean(loss)
+    prob = fluid.layers.softmax(logits)
+    acc = fluid.layers.accuracy(input=prob, label=label)
+
+    accs = _train(avg_cost, acc)
+    assert accs[-1] > 0.9, f"accuracy too low: {accs}"
+
+
+def test_recognize_digits_conv():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2, pool_stride=2,
+        act="relu")
+    c2 = nets.simple_img_conv_pool(
+        input=c1, filter_size=5, num_filters=16, pool_size=2, pool_stride=2,
+        act="relu")
+    logits = fluid.layers.fc(input=c2, size=10, num_flatten_dims=1)
+    prob = fluid.layers.softmax(logits)
+    loss = fluid.layers.cross_entropy(input=prob, label=label)
+    avg_cost = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prob, label=label)
+
+    accs = _train(avg_cost, acc, epochs=4)
+    assert accs[-1] > 0.9, f"accuracy too low: {accs}"
+
+
+def test_batch_norm_training_and_eval():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3)
+    bn = fluid.layers.batch_norm(input=conv, act="relu")
+    logits = fluid.layers.fc(input=bn, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = fluid.layers.mean(loss)
+
+    test_program = fluid.default_main_program().clone(for_test=True)
+
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = _synthetic_digits(128)
+
+    scope = fluid.global_scope()
+    mean_name = [n for n in scope.local_names()]
+    for _ in range(8):
+        exe.run(feed={"img": xs[:64], "label": ys[:64]},
+                fetch_list=[avg_cost])
+    # running stats must have moved away from init (0 mean / 1 var)
+    bn_means = [n for n in scope.local_names() if "batch_norm" in n
+                and "global" in n]
+    assert bn_means, "BN running stats not in scope"
+    moved = any(
+        not np.allclose(scope.find_np(n), 0.0) and
+        not np.allclose(scope.find_np(n), 1.0)
+        for n in bn_means
+    )
+    assert moved, "BN running stats never updated"
+    # eval-mode program runs without labels-grad machinery
+    (test_loss,) = exe.run(test_program,
+                           feed={"img": xs[64:], "label": ys[64:]},
+                           fetch_list=[avg_cost])
+    assert np.isfinite(test_loss).all()
