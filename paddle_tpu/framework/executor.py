@@ -124,8 +124,15 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _prepare_feeds(self, block, feed: Dict[str, object]):
+        import jax
+
         out = {}
         for name, value in feed.items():
+            if isinstance(value, jax.Array):
+                # already device-resident (e.g. from a prefetching DataFeeder):
+                # no host-side cast/copy — feed as-is
+                out[name] = value
+                continue
             arr = np.asarray(value)
             if block.has_var(name):
                 var = block.var(name)

@@ -1,0 +1,5 @@
+"""Model zoo: TPU-native counterparts of the reference's benchmark and book
+models (benchmark/paddle/image/{resnet,vgg,alexnet,googlenet}.py and
+fluid/tests/book/)."""
+
+from . import resnet  # noqa: F401

@@ -26,15 +26,18 @@ def sgd(ctx, ins, attrs):
 
 @register_op("momentum", grad=None)
 def momentum(ctx, ins, attrs):
+    jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
     v = ins["Velocity"][0]
     lr = ins["LearningRate"][0].reshape(())
     mu = float(attrs["mu"])
-    v_out = mu * v + g
+    # accumulator stays float32 even for bf16 params (mixed precision)
+    v_out = mu * v + g.astype(v.dtype)
     if attrs.get("use_nesterov", False):
-        p_out = p - (g + mu * v_out) * lr
+        upd = (g.astype(v.dtype) + mu * v_out) * lr
     else:
-        p_out = p - lr * v_out
+        upd = lr * v_out
+    p_out = (p.astype(jnp.float32) - upd).astype(p.dtype)
     return {"ParamOut": [p_out], "VelocityOut": [v_out]}
 
 
@@ -53,7 +56,8 @@ def adam(ctx, ins, attrs):
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-    p_out = p - (lr_t * m_out / (jnp.sqrt(v_out) + eps)).astype(p.dtype)
+    p_out = (p.astype(jnp.float32)
+             - lr_t * m_out / (jnp.sqrt(v_out) + eps)).astype(p.dtype)
     return {"ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out]}
 
 
