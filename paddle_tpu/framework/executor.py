@@ -59,6 +59,8 @@ class Executor:
         self.place = place if place is not None else default_place()
         self._cache: Dict[tuple, _Compiled] = {}
         self._step = 0
+        # subclasses running sharded over a mesh bypass single-device pinning
+        self._pin_device = True
 
     # ------------------------------------------------------------------
     def run(
@@ -114,7 +116,11 @@ class Executor:
         )
         self._step += 1
 
-        with jax.default_device(self.place.jax_device()):
+        if self._pin_device:
+            with jax.default_device(self.place.jax_device()):
+                fetches, new_state = compiled.fn(
+                    state_w, state_r, feed_vals, rng)
+        else:
             fetches, new_state = compiled.fn(state_w, state_r, feed_vals, rng)
         for n, v in new_state.items():
             scope.set(n, v)

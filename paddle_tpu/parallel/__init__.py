@@ -1,0 +1,3 @@
+from .mesh import make_mesh  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .transpiler import DistributeTranspiler, ShardingRules  # noqa: F401

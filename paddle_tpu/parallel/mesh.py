@@ -1,0 +1,38 @@
+"""Device mesh construction (SPMD over ICI).
+
+Replaces all four reference communication backends (SURVEY.md §2.16/§5):
+NCCL collective ops (operators/nccl_op.cc), the C++ socket pserver
+(paddle/pserver), the Go pserver/master (go/), and gRPC send/recv
+(operators/detail) — data/model parallelism become sharding annotations over a
+`jax.sharding.Mesh`; XLA emits all-reduce/all-gather/reduce-scatter over ICI.
+
+Axis names:
+  dp — data parallel (batch axis)
+  mp — model/tensor parallel (hidden/vocab axes)
+  sp — sequence parallel (long-context time axis)
+  pp — pipeline stages (reserved)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
+    """Build a Mesh. `axes` maps axis name → size; total must divide the
+    device count. Default: pure DP over all devices."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes.keys())
+    sizes = [int(axes[n]) for n in names]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {axes} needs {total} devices, have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=names)

@@ -1,0 +1,168 @@
+"""ParallelExecutor: SPMD execution of a program over a device mesh.
+
+Replaces the reference's whole multi-device story (SURVEY.md §2.16):
+MultiGradientMachine's thread-per-GPU + host aggregation
+(gserver/gradientmachines/MultiGradientMachine.cpp:279/469/502), the
+parallel_do op (operators/parallel_do_op.cc:82), NCCL allreduce ops, and the
+pserver data-parallel path.  The SAME program the single-chip Executor runs is
+jitted with NamedShardings: batch-sharded feeds ('dp'), optionally
+tensor-sharded weights ('mp'), replicated small state.  XLA GSPMD partitions
+the computation and emits ICI collectives (gradient all-reduce appears
+automatically from the replicated-param + sharded-batch math).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..framework.core import np_dtype
+from ..framework.executor import Executor, _lower_ops
+from ..framework.scope import global_scope
+from ..ops.registry import EmitContext
+from .mesh import make_mesh
+from .transpiler import DistributeTranspiler, ShardingRules
+
+
+class ParallelExecutor(Executor):
+    def __init__(self, mesh=None, axes: Optional[Dict[str, int]] = None,
+                 rules: Optional[ShardingRules] = None, devices=None):
+        super().__init__(place=None)
+        self._pin_device = False
+        self.mesh = mesh if mesh is not None else make_mesh(axes, devices)
+        self.transpiler = DistributeTranspiler(rules)
+        self._plans: Dict[int, Dict[str, object]] = {}
+        self._sharded_scopes = set()
+
+    # ------------------------------------------------------------------
+    def _plan_for(self, program):
+        key = (id(program), program._version)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = self.transpiler.transpile(program, self.mesh)
+            self._plans[key] = plan
+        return plan
+
+    def _replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _shard_of(self, plan, name):
+        s = plan.get(name)
+        if s is not None:
+            return s
+        # optimizer accumulators follow their parameter (name prefix match)
+        best = None
+        for pname, sh in plan.items():
+            if name.startswith(pname) and (best is None or
+                                           len(pname) > len(best[0])):
+                best = (pname, sh)
+        return best[1] if best else self._replicated()
+
+    # ------------------------------------------------------------------
+    def _prepare_feeds(self, block, feed):
+        import jax
+
+        program = block.program
+        plan = self._plan_for(program)
+        out = {}
+        for name, value in feed.items():
+            if isinstance(value, jax.Array):
+                out[name] = value
+                continue
+            arr = np.asarray(value)
+            if block.has_var(name):
+                var = block.var(name)
+                if var.dtype is not None:
+                    arr = arr.astype(np_dtype(var.dtype), copy=False)
+                sharding = plan.get(name) or self._replicated()
+            else:
+                sharding = self._replicated()
+            out[name] = jax.device_put(arr, sharding)
+        return out
+
+    def _distribute_state(self, program, scope, names):
+        """device_put persistables to their planned shardings (once)."""
+        import jax
+
+        plan = self._plan_for(program)
+        for n in names:
+            v = scope.find(n)
+            if v is None:
+                continue
+            tag = (id(scope), n)
+            if tag in self._sharded_scopes:
+                continue
+            scope.set(n, jax.device_put(v, self._shard_of(plan, n)))
+            self._sharded_scopes.add(tag)
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, block_id=0):
+        from ..framework.core import default_main_program
+
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        block = program.blocks[block_id]
+        # pre-shard all scope state the block touches
+        names = set()
+        for op in block.ops:
+            names.update(op.input_names())
+            names.update(op.output_names())
+        self._distribute_state(
+            program, scope, [n for n in names if scope.has(n)])
+        return super().run(program, feed, fetch_list, scope, return_numpy,
+                           block_id)
+
+    # ------------------------------------------------------------------
+    def _compile(self, program, block_id, feed_vals, fetch_names):
+        import jax
+
+        block = program.blocks[block_id]
+        feed_names = list(feed_vals.keys())
+        external_reads, rw_state, written_state = self._analyze(
+            block, feed_names)
+        is_test = not any(
+            op.type.endswith("_grad") or op.type == "generic_grad"
+            for op in block.ops
+        )
+        plan = self._plan_for(program)
+
+        def step_fn(state_w, state_r, feeds, rng_key):
+            env = {}
+            env.update(state_r)
+            env.update(state_w)
+            env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
+            ctx = EmitContext(rng_key, is_test=is_test, program=program)
+            ctx.mesh = self.mesh
+            ctx.lower_block = lambda idx, sub_env: _lower_ops(
+                program.blocks[idx].ops, sub_env, ctx)
+            _lower_ops(block.ops, env, ctx)
+            fetches = {n: env[n] for n in fetch_names}
+            # no `if in env` guard: out_shardings is built per written_state,
+            # so the output pytree structure must match it exactly
+            new_state = {n: env[n] for n in written_state}
+            return fetches, new_state
+
+        in_shardings = (
+            {n: self._shard_of(plan, n) for n in rw_state},
+            {n: self._shard_of(plan, n) for n in external_reads},
+            {n: (plan.get(n) or self._replicated()) for n in feed_names},
+            self._replicated(),
+        )
+        # keep state shardings stable across steps; fetches unconstrained
+        out_shardings = (
+            None,
+            {n: self._shard_of(plan, n) for n in written_state},
+        )
+        jitted = jax.jit(
+            step_fn,
+            donate_argnums=(0,),
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+        )
+        from ..framework.executor import _Compiled
+
+        return _Compiled(jitted, external_reads, rw_state, written_state,
+                         fetch_names)

@@ -1,14 +1,30 @@
 """Test env: 8 virtual CPU devices — the 'fake cluster' (SURVEY.md §4's
-upgrade over the reference's in-process loopback/notest_dist tricks)."""
+upgrade over the reference's in-process loopback/notest_dist tricks).
+
+The environment may have a TPU plugin that force-selects its platform via
+jax.config (sitecustomize). Tests override back to CPU *before* the CPU
+backend initializes so --xla_force_host_platform_device_count takes effect."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if len(jax.devices()) < 8:  # platform was pinned before we got here
+    from jax._src import xla_bridge
+
+    xla_bridge.get_backend.cache_clear()
+    xla_bridge._clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices()) == 8
 
 import pytest  # noqa: E402
 
