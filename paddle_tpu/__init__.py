@@ -24,6 +24,9 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from . import io  # noqa: F401
+from . import nets  # noqa: F401
+from . import compiler  # noqa: F401
+from .lod import LoDTensor  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
 from .framework.backward import append_backward  # noqa: F401

@@ -132,6 +132,8 @@ class Executor:
     def _prepare_feeds(self, block, feed: Dict[str, object]):
         import jax
 
+        from ..lod import LENGTH_SUFFIX, as_lod_tensor, is_lod_feed
+
         out = {}
         for name, value in feed.items():
             if isinstance(value, jax.Array):
@@ -139,11 +141,19 @@ class Executor:
                 # no host-side cast/copy — feed as-is
                 out[name] = value
                 continue
-            arr = np.asarray(value)
-            if block.has_var(name):
-                var = block.var(name)
+            var = block.var(name) if block.has_var(name) else None
+            if var is not None and var.lod_level > 0 and is_lod_feed(value):
+                # ragged feed → bucket-padded dense + int32 lengths companion
+                lt = as_lod_tensor(value)
+                padded, lengths = lt.to_padded(bucket=True)
                 if var.dtype is not None:
-                    arr = arr.astype(np_dtype(var.dtype), copy=False)
+                    padded = padded.astype(np_dtype(var.dtype), copy=False)
+                out[name] = padded
+                out[name + LENGTH_SUFFIX] = lengths
+                continue
+            arr = np.asarray(value)
+            if var is not None and var.dtype is not None:
+                arr = arr.astype(np_dtype(var.dtype), copy=False)
             out[name] = arr
         return out
 

@@ -1,4 +1,17 @@
 from .nn import *  # noqa: F401,F403
+from .sequence import (  # noqa: F401
+    dynamic_gru,
+    dynamic_lstm,
+    get_length_var,
+    propagate_length,
+    sequence_conv,
+    sequence_data,
+    sequence_embedding,
+    sequence_fc,
+    sequence_pool,
+    sequence_reverse,
+    sequence_softmax,
+)
 from .tensor import (  # noqa: F401
     assign,
     cast,

@@ -3,3 +3,4 @@ models (benchmark/paddle/image/{resnet,vgg,alexnet,googlenet}.py and
 fluid/tests/book/)."""
 
 from . import resnet  # noqa: F401
+from . import seq2seq  # noqa: F401

@@ -9,5 +9,7 @@ from . import math_ops  # noqa: F401
 from . import activation_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import attention_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from .registry import EmitContext, get_op_info, has_op, register_op, registered_ops  # noqa: F401

@@ -1,0 +1,168 @@
+"""Attention decoder + beam-search generation as compiled scans.
+
+Replaces the reference's v1 seq2seq engine — RecurrentGradientMachine's
+per-step unrolling with AgentLayers (gradientmachines/
+RecurrentGradientMachine.cpp, `generateSequence` :307 / `beamSearch` :309,
+`Path` struct) and the fluid beam_search ops (operators/beam_search_op.h:96,
+beam_search_decode_op) — with whole-sequence `lax.scan` programs: the decoder
+(train, teacher-forced) and the beam search (generate) each compile to a
+single XLA computation; top-k beam steps run on-device via lax.top_k.
+
+Attention is Bahdanau additive (trainer_config_helpers/networks.py:1400
+simple_attention): score = v·tanh(W_q h + W_m enc)."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _attend(h, enc_proj, enc_out, enc_mask, w_q, v):
+    """h [.., H]; enc_proj [B,Ts,A]; enc_out [B,Ts,E]; enc_mask [B,Ts].
+    Leading dims of h beyond batch broadcast (beams)."""
+    import jax
+    import jax.numpy as jnp
+
+    q = h @ w_q  # [..., A]
+    if h.ndim == 2:
+        e = jnp.tanh(enc_proj + q[:, None, :]) @ v  # [B,Ts]
+        e = jnp.where(enc_mask > 0, e, -1e9)
+        a = jax.nn.softmax(e, axis=-1)
+        ctx = jnp.einsum("bt,bte->be", a, enc_out)
+    else:  # [B,K,H] beams
+        e = jnp.tanh(enc_proj[:, None] + q[:, :, None, :]) @ v  # [B,K,Ts]
+        e = jnp.where(enc_mask[:, None] > 0, e, -1e9)
+        a = jax.nn.softmax(e, axis=-1)
+        ctx = jnp.einsum("bkt,bte->bke", a, enc_out)
+    return ctx, a
+
+
+def _gru_cell(xc, h, w_in, b_in, w_h):
+    """xc [..,Din] (input ++ context), h [..,H]; w_in [Din,3H], w_h [H,3H]."""
+    import jax
+    import jax.numpy as jnp
+
+    H = h.shape[-1]
+    g_in = xc @ w_in + b_in
+    g = g_in[..., : 2 * H] + h @ w_h[:, : 2 * H]
+    u = jax.nn.sigmoid(g[..., :H])
+    r = jax.nn.sigmoid(g[..., H:])
+    c = jnp.tanh(g_in[..., 2 * H:] + (r * h) @ w_h[:, 2 * H:])
+    return u * h + (1 - u) * c
+
+
+def _mask(lengths, T):
+    import jax.numpy as jnp
+
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+
+@register_op("attention_gru_decoder",
+             non_diff_inputs=("EncLength", "TgtLength"))
+def attention_gru_decoder(ctx, ins, attrs):
+    """Teacher-forced attention decoder.
+
+    Inputs: EncOut [B,Ts,E], EncLength [B], TgtEmb [B,Tt,D], TgtLength [B],
+    H0 [B,H], WIn [D+E,3H], BIn [3H], WH [H,3H], WQuery [H,A], WMem [E,A],
+    V [A].  Outputs: Hidden [B,Tt,H], Context [B,Tt,E]."""
+    import jax
+    import jax.numpy as jnp
+
+    enc_out = ins["EncOut"][0]
+    enc_len = ins["EncLength"][0]
+    tgt = ins["TgtEmb"][0]
+    h0 = ins["H0"][0]
+    w_in, b_in = ins["WIn"][0], ins["BIn"][0]
+    w_h = ins["WH"][0]
+    w_q, w_m, v = ins["WQuery"][0], ins["WMem"][0], ins["V"][0]
+
+    B, Ts, E = enc_out.shape
+    Tt = tgt.shape[1]
+    enc_mask = _mask(enc_len, Ts)
+    enc_proj = enc_out @ w_m  # [B,Ts,A] — hoisted out of the scan
+
+    def step(h, t):
+        ctx_vec, _ = _attend(h, enc_proj, enc_out, enc_mask, w_q, v)
+        xc = jnp.concatenate([tgt[:, t], ctx_vec], axis=-1)
+        h_new = _gru_cell(xc, h, w_in, b_in, w_h)
+        return h_new, (h_new, ctx_vec)
+
+    _, (hs, ctxs) = jax.lax.scan(step, h0, jnp.arange(Tt))
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)],
+            "Context": [jnp.moveaxis(ctxs, 0, 1)]}
+
+
+@register_op("beam_search_generate", grad=None)
+def beam_search_generate(ctx, ins, attrs):
+    """Beam-search decoding, fully on device.
+
+    Inputs: EncOut [B,Ts,E], EncLength [B], Embedding [V,D], H0 [B,H],
+    WIn/BIn/WH/WQuery/WMem/V (decoder cell as above), WOut [H(+E),Vo], BOut.
+    Attrs: beam_size, max_len, bos_id, eos_id.
+    Outputs: Ids [B,K,max_len] int32, Scores [B,K] (total log-prob),
+    Lengths [B,K] int32."""
+    import jax
+    import jax.numpy as jnp
+
+    enc_out = ins["EncOut"][0]
+    enc_len = ins["EncLength"][0]
+    emb = ins["Embedding"][0]
+    h0 = ins["H0"][0]
+    w_in, b_in = ins["WIn"][0], ins["BIn"][0]
+    w_h = ins["WH"][0]
+    w_q, w_m, v = ins["WQuery"][0], ins["WMem"][0], ins["V"][0]
+    w_out, b_out = ins["WOut"][0], ins["BOut"][0]
+
+    K = int(attrs.get("beam_size", 4))
+    L = int(attrs.get("max_len", 32))
+    bos = int(attrs.get("bos_id", 0))
+    eos = int(attrs.get("eos_id", 1))
+
+    B, Ts, E = enc_out.shape
+    H = h0.shape[-1]
+    Vo = w_out.shape[-1]
+    enc_mask = _mask(enc_len, Ts)
+    enc_proj = enc_out @ w_m
+
+    # state over beams
+    h = jnp.broadcast_to(h0[:, None], (B, K, H))
+    tokens = jnp.full((B, K), bos, dtype=jnp.int32)
+    # only beam 0 live initially (identical beams would divide the search)
+    scores = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, -1e9)
+    scores = jnp.broadcast_to(scores, (B, K))
+    finished = jnp.zeros((B, K), dtype=bool)
+    ids_hist = jnp.zeros((B, K, L), dtype=jnp.int32)
+    lengths = jnp.zeros((B, K), dtype=jnp.int32)
+
+    def step(carry, t):
+        h, tokens, scores, finished, ids_hist, lengths = carry
+        x = emb[tokens]  # [B,K,D]
+        ctx_vec, _ = _attend(h, enc_proj, enc_out, enc_mask, w_q, v)
+        xc = jnp.concatenate([x, ctx_vec], axis=-1)
+        h_new = _gru_cell(xc, h, w_in, b_in, w_h)
+        logits = h_new @ w_out + b_out  # [B,K,Vo]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        # finished beams only extend with eos at zero cost
+        eos_only = jnp.full((Vo,), -1e9).at[eos].set(0.0)
+        logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
+        cand = scores[..., None] + logp  # [B,K,Vo]
+        flat = cand.reshape(B, K * Vo)
+        top_scores, top_idx = jax.lax.top_k(flat, K)  # [B,K]
+        beam_idx = top_idx // Vo
+        tok_idx = (top_idx % Vo).astype(jnp.int32)
+        take = lambda a: jnp.take_along_axis(
+            a, beam_idx.reshape((B, K) + (1,) * (a.ndim - 2)), axis=1)
+        h_sel = take(h_new)
+        fin_sel = jnp.take_along_axis(finished, beam_idx, axis=1)
+        hist_sel = take(ids_hist)
+        len_sel = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        ids_hist_new = hist_sel.at[:, :, t].set(
+            jnp.where(fin_sel, eos, tok_idx))
+        len_new = jnp.where(fin_sel, len_sel, len_sel + 1)
+        fin_new = fin_sel | (tok_idx == eos)
+        return (h_sel, tok_idx, top_scores, fin_new, ids_hist_new,
+                len_new), None
+
+    carry = (h, tokens, scores, finished, ids_hist, lengths)
+    carry, _ = jax.lax.scan(step, carry, jnp.arange(L))
+    h, tokens, scores, finished, ids_hist, lengths = carry
+    return {"Ids": [ids_hist], "Scores": [scores], "Lengths": [lengths]}

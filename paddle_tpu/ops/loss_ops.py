@@ -17,8 +17,8 @@ def cross_entropy(ctx, ins, attrs):
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
     else:
-        idx = label.reshape(-1).astype(jnp.int32)
-        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        idx = label.reshape(x.shape[:-1] + (1,)).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, idx, axis=-1)
         loss = -jnp.log(picked + eps)
     return {"Y": [loss]}
 
@@ -38,8 +38,8 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        idx = label.reshape(-1).astype(jnp.int32)
-        loss = -jnp.take_along_axis(logp, idx[:, None], axis=-1)
+        idx = label.reshape(logp.shape[:-1] + (1,)).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, idx, axis=-1)
     return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
 
 

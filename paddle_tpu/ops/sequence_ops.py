@@ -1,0 +1,340 @@
+"""Sequence ops on (padded, lengths) pairs + scan recurrences.
+
+Reference machinery being replaced (SURVEY.md §2.2 'Sequence/LoD ops'):
+sequence_{pool,softmax,expand,concat,conv}_op.cc, lstm/gru ops with the
+sequence2batch reordering (operators/math/sequence2batch.h) and fused cell
+kernels (math/detail/lstm_gpu_kernel.h), shrink_rnn_memory / LoDRankTable
+batch-shrinking.  Here every op takes the padded tensor plus an int32
+`Length` input and masks; recurrences are single `lax.scan`s whose per-step
+math XLA fuses into one kernel — batch stays MXU-shaped instead of shrinking.
+"""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _mask(lengths, T, dtype):
+    import jax.numpy as jnp
+
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool", non_diff_inputs=("Length",))
+def sequence_pool(ctx, ins, attrs):
+    """[B,T,D]+len → [B,D]; pooltype sum|average|sqrt|max|last|first."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    ptype = attrs.get("pooltype", "average").lower()
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(lengths, T, x.dtype)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    if ptype == "sum":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "average":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            lengths.astype(x.dtype), 1)[:, None]
+    elif ptype == "sqrt":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(
+            jnp.maximum(lengths.astype(x.dtype), 1))[:, None]
+    elif ptype == "max":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    elif ptype == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", non_diff_inputs=("Length",))
+def sequence_softmax(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [B, T]
+    lengths = ins["Length"][0]
+    m = _mask(lengths, x.shape[1], jnp.float32)
+    logits = jnp.where(m > 0, x.astype(jnp.float32), -1e9)
+    return {"Out": [jax.nn.softmax(logits, axis=-1).astype(x.dtype) * m.astype(x.dtype)]}
+
+
+@register_op("sequence_expand", non_diff_inputs=("Length",))
+def sequence_expand(ctx, ins, attrs):
+    """Broadcast one row per sequence across its timesteps:
+    [B,D]+len → [B,T,D] masked (the padded-batch reading of
+    sequence_expand_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    T = int(attrs["max_len"])
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    m = _mask(lengths, T, x.dtype)
+    while m.ndim < out.ndim:
+        m = m[..., None]
+    return {"Out": [out * m]}
+
+
+@register_op("sequence_reverse", non_diff_inputs=("Length",))
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse each sequence within its true length (for bi-RNNs)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = lengths[:, None] - 1 - idx
+    rev = jnp.where(rev >= 0, rev, idx)  # padding keeps identity order
+    return {"Y": [jnp.take_along_axis(
+        x, rev.astype(jnp.int32).reshape(rev.shape + (1,) * (x.ndim - 2)),
+        axis=1)]}
+
+
+@register_op("sequence_conv", non_diff_inputs=("Length",))
+def sequence_conv(ctx, ins, attrs):
+    """Context-window projection over time (sequence_conv_op.cc /
+    ContextProjection): gather a [context_length] window per step, project."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]  # [context_length*D, M]
+    lengths = ins["Length"][0]
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    B, T, D = x.shape
+    m = _mask(lengths, T, x.dtype)[..., None]
+    xm = x * m
+    cols = []
+    for k in range(ctx_len):
+        shift = ctx_start + k
+        rolled = jnp.roll(xm, -shift, axis=1)
+        if shift > 0:
+            rolled = rolled.at[:, T - shift:].set(0.0)
+        elif shift < 0:
+            rolled = rolled.at[:, : -shift].set(0.0)
+        cols.append(rolled)
+    col = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = col.reshape(B * T, -1) @ w
+    return {"Out": [out.reshape(B, T, -1) * m]}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": [jnp.concatenate(ins["X"], axis=-1)]}
+
+
+@register_op("sequence_erase", grad=None, non_diff_inputs=("Length",))
+def sequence_erase(ctx, ins, attrs):
+    """Mark erased tokens (can't compact under static shapes: tokens matching
+    `tokens` are replaced by pad 0 and lengths recomputed)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), dtype=x.dtype)
+    keep = jnp.all(x[..., None] != tokens, axis=-1)
+    m = _mask(lengths, x.shape[1], jnp.bool_)
+    keep = keep & m
+    return {"Out": [jnp.where(keep, x, 0)],
+            "LengthOut": [jnp.sum(keep, axis=1).astype(jnp.int32)]}
+
+
+@register_op("masked_seq_mean", non_diff_inputs=("Length",))
+def masked_seq_mean(ctx, ins, attrs):
+    """Mean of per-token values [B,T,...] over true (unpadded) tokens →
+    scalar [1] (the masked-loss reduction for seq2seq training)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lengths = ins["Length"][0]
+    m = _mask(lengths, x.shape[1], x.dtype)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    total = jnp.sum(x * m)
+    count = jnp.maximum(jnp.sum(lengths).astype(x.dtype), 1.0)
+    return {"Out": [(total / count).reshape((1,))]}
+
+
+# ---------------------------------------------------------------------------
+# Recurrences: lax.scan LSTM / GRU
+
+
+def _lstm_scan(x_proj, h0, c0, w_h, lengths, gate_act, cell_act, cand_act,
+               reverse=False):
+    """x_proj [B,T,4H] (input already projected), w_h [H,4H].
+    Paddle gate layout (lstm_op.cc): i, f, c̃, o chunks."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    m = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+
+    def step(carry, t):
+        h, c = carry
+        idx = T - 1 - t if reverse else t
+        g = x_proj[:, idx] + h @ w_h
+        i = gate_act(g[:, :H])
+        f = gate_act(g[:, H: 2 * H])
+        ct = cand_act(g[:, 2 * H: 3 * H])
+        o = gate_act(g[:, 3 * H:])
+        c_new = f * c + i * ct
+        h_new = o * cell_act(c_new)
+        mt = m[:, idx][:, None]
+        h_new = mt * h_new + (1 - mt) * h
+        c_new = mt * c_new + (1 - mt) * c
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_T, c_T), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    hs = jnp.moveaxis(hs, 0, 1)  # [B,T,H]
+    cs = jnp.moveaxis(cs, 0, 1)
+    if reverse:
+        hs = hs[:, ::-1]
+        cs = cs[:, ::-1]
+    return hs, cs, h_T, c_T
+
+
+def _acts():
+    import jax
+    import jax.numpy as jnp
+
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+
+
+@register_op("lstm", non_diff_inputs=("Length",),
+             non_diff_outputs=("Cell",))
+def lstm(ctx, ins, attrs):
+    """dynamic_lstm (operators/lstm_op.cc): Input [B,T,4H] pre-projected,
+    Weight [H,4H], Bias [4H] (+peephole ignored for now)."""
+    import jax.numpy as jnp
+
+    acts = _acts()
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    lengths = ins["Length"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    B = x.shape[0]
+    H = w.shape[0]
+    if bias is not None:
+        x = x + bias[: 4 * H][None, None, :]
+    h0 = jnp.zeros((B, H), x.dtype)
+    c0 = jnp.zeros((B, H), x.dtype)
+    if ins.get("H0") and ins["H0"][0] is not None:
+        h0 = ins["H0"][0]
+    if ins.get("C0") and ins["C0"][0] is not None:
+        c0 = ins["C0"][0]
+    hs, cs, _, _ = _lstm_scan(
+        x, h0, c0, w, lengths,
+        acts[attrs.get("gate_activation", "sigmoid")],
+        acts[attrs.get("cell_activation", "tanh")],
+        acts[attrs.get("candidate_activation", "tanh")],
+        reverse=bool(attrs.get("is_reverse", False)),
+    )
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+def _gru_scan(x_proj, h0, w_h, lengths, gate_act, cand_act, reverse=False):
+    """x_proj [B,T,3H], w_h [H,3H] split as [H,2H] gates + [H,H] candidate
+    (gru_op.cc layout: update u, reset r, candidate c)."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T, H3 = x_proj.shape
+    H = H3 // 3
+    w_gates = w_h[:, : 2 * H]
+    w_cand = w_h[:, 2 * H:]
+    m = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+
+    def step(h, t):
+        idx = T - 1 - t if reverse else t
+        xt = x_proj[:, idx]
+        g = xt[:, : 2 * H] + h @ w_gates
+        u = gate_act(g[:, :H])
+        r = gate_act(g[:, H:])
+        c = cand_act(xt[:, 2 * H:] + (r * h) @ w_cand)
+        h_new = u * h + (1 - u) * c
+        mt = m[:, idx][:, None]
+        h_new = mt * h_new + (1 - mt) * h
+        return h_new, h_new
+
+    h_T, hs = jax.lax.scan(step, h0, jnp.arange(T))
+    hs = jnp.moveaxis(hs, 0, 1)
+    if reverse:
+        hs = hs[:, ::-1]
+    return hs, h_T
+
+
+@register_op("gru", non_diff_inputs=("Length",))
+def gru(ctx, ins, attrs):
+    import jax.numpy as jnp
+
+    acts = _acts()
+    x = ins["Input"][0]  # [B,T,3H]
+    w = ins["Weight"][0]  # [H,3H]
+    lengths = ins["Length"][0]
+    H = w.shape[0]
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        x = x + ins["Bias"][0][None, None, :]
+    B = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((B, H), x.dtype)
+    hs, _ = _gru_scan(
+        x, h0, w, lengths,
+        acts[attrs.get("gate_activation", "sigmoid")],
+        acts[attrs.get("activation", "tanh")],
+        reverse=bool(attrs.get("is_reverse", False)),
+    )
+    return {"Hidden": [hs]}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """Single LSTM step (lstm_unit_op.cc): X [B,4H] pre-projected incl.
+    recurrent term, C_prev [B,H]."""
+    import jax
+    import jax.numpy as jnp
+
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    H = c_prev.shape[-1]
+    fb = float(attrs.get("forget_bias", 0.0))
+    i = jax.nn.sigmoid(x[:, :H])
+    f = jax.nn.sigmoid(x[:, H: 2 * H] + fb)
+    ct = jnp.tanh(x[:, 2 * H: 3 * H])
+    o = jax.nn.sigmoid(x[:, 3 * H:])
+    c = f * c_prev + i * ct
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    """Single GRU step (gru_unit_op.cc): Input [B,3H], HiddenPrev [B,H],
+    Weight [H,3H]."""
+    import jax
+    import jax.numpy as jnp
+
+    x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    H = h_prev.shape[-1]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    if b is not None:
+        x = x + b[None, :]
+    g = x[:, : 2 * H] + h_prev @ w[:, : 2 * H]
+    u = jax.nn.sigmoid(g[:, :H])
+    r = jax.nn.sigmoid(g[:, H:])
+    c = jnp.tanh(x[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+    h = u * h_prev + (1 - u) * c
+    return {"Hidden": [h], "Gate": [g], "ResetHiddenPrev": [r * h_prev]}

@@ -1,0 +1,129 @@
+"""Sequence machinery tests: LoDTensor round-trips, masked sequence ops, and
+the understand_sentiment-style LSTM/GRU classifiers (reference
+fluid/tests/book/test_understand_sentiment_{conv,dynamic_lstm}.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDTensor
+
+
+def test_lod_tensor_roundtrip():
+    seqs = [np.arange(3), np.arange(5), np.arange(2)]
+    lt = LoDTensor.from_sequences(seqs)
+    assert lt.lod == [[0, 3, 8, 10]]
+    assert lt.num_sequences == 3
+    np.testing.assert_array_equal(lt.sequence_lengths(), [3, 5, 2])
+    padded, lens = lt.to_padded()
+    assert padded.shape[0] == 3 and padded.shape[1] == 8  # bucket(5)=8
+    back = LoDTensor.from_padded(padded, lens)
+    for a, b in zip(back.sequences(), seqs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sequence_pool_masks_padding():
+    x = fluid.layers.sequence_data(name="x", shape=[4], dtype="float32")
+    avg = fluid.layers.sequence_pool(x, pool_type="average")
+    mx = fluid.layers.sequence_pool(x, pool_type="max")
+    last = fluid.layers.sequence_pool(x, pool_type="last")
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.ones((2, 4), np.float32), 3 * np.ones((5, 4), np.float32)]
+    seqs[0][1] = 7.0
+    a, m, l = exe.run(feed={"x": LoDTensor.from_sequences(seqs)},
+                      fetch_list=[avg, mx, last])
+    np.testing.assert_allclose(a[0], (1 + 7) / 2 * np.ones(4))
+    np.testing.assert_allclose(a[1], 3 * np.ones(4))
+    np.testing.assert_allclose(m[0], 7 * np.ones(4))
+    np.testing.assert_allclose(l[0], 7 * np.ones(4))
+    np.testing.assert_allclose(l[1], 3 * np.ones(4))
+
+
+def _sentiment_data(n=96, vocab=100, seed=0):
+    """Class = majority token parity; variable lengths."""
+    rng = np.random.RandomState(seed)
+    seqs, labels = [], []
+    for _ in range(n):
+        ln = rng.randint(3, 12)
+        label = rng.randint(0, 2)
+        # tokens even → class 0, odd → class 1 (strong signal)
+        toks = rng.randint(0, vocab // 2, ln) * 2 + label
+        seqs.append(toks.reshape(-1, 1).astype(np.int64))
+        labels.append([label])
+    return seqs, np.asarray(labels, dtype=np.int64)
+
+
+def test_understand_sentiment_dynamic_lstm():
+    H = 32
+    words = fluid.layers.sequence_data(name="words", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[100, 32])
+    proj = fluid.layers.sequence_fc(emb, size=4 * H)
+    hidden, _ = fluid.layers.dynamic_lstm(proj, size=4 * H)
+    pooled = fluid.layers.sequence_pool(hidden, pool_type="last")
+    logits = fluid.layers.fc(input=pooled, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs, labels = _sentiment_data()
+    accs = []
+    for _ in range(15):
+        l, a = exe.run(
+            feed={"words": LoDTensor.from_sequences(seqs), "label": labels},
+            fetch_list=[loss, acc])
+        accs.append(float(a.item()))
+    assert accs[-1] > 0.9, accs
+
+
+def test_gru_and_bidirectional():
+    H = 16
+    words = fluid.layers.sequence_data(name="words", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[100, 16])
+    proj = fluid.layers.sequence_fc(emb, size=3 * H)
+    fwd = fluid.layers.dynamic_gru(proj, size=H)
+    bwd = fluid.layers.dynamic_gru(proj, size=H, is_reverse=True)
+    both = fluid.layers.concat([fwd, bwd], axis=2)
+    fluid.layers.propagate_length(fwd, both)
+    pooled = fluid.layers.sequence_pool(both, pool_type="max")
+    logits = fluid.layers.fc(input=pooled, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs, labels = _sentiment_data(64)
+    losses = []
+    for _ in range(10):
+        (l,) = exe.run(
+            feed={"words": LoDTensor.from_sequences(seqs), "label": labels},
+            fetch_list=[loss])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_sequence_conv_sentiment():
+    words = fluid.layers.sequence_data(name="words", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[100, 16])
+    conv = fluid.layers.sequence_conv(emb, num_filters=24, filter_size=3,
+                                      act="relu")
+    pooled = fluid.layers.sequence_pool(conv, pool_type="max")
+    logits = fluid.layers.fc(input=pooled, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs, labels = _sentiment_data(64)
+    losses = []
+    for _ in range(10):
+        (l,) = exe.run(
+            feed={"words": LoDTensor.from_sequences(seqs), "label": labels},
+            fetch_list=[loss])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0]
