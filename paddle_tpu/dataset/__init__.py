@@ -1,0 +1,18 @@
+"""Dataset loaders (reference python/paddle/v2/dataset/: mnist, cifar, imdb,
+imikolov, movielens, uci_housing, wmt14, sentiment, ...).
+
+Same reader contract as the reference (creator functions returning sample
+generators).  This build runs zero-egress: each loader first looks for real
+data under the cache dir (`~/.cache/paddle_tpu/<name>` or $PADDLE_TPU_DATA),
+and otherwise serves a deterministic synthetic surrogate with the exact
+schema (shapes, dtypes, vocab conventions) so pipelines and book tests run
+anywhere."""
+
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import mnist  # noqa: F401
+from . import movielens  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import wmt14  # noqa: F401
+from .common import DATA_HOME  # noqa: F401

@@ -1,0 +1,44 @@
+"""CIFAR-10/100 (reference v2/dataset/cifar.py): 3x32x32 images."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+
+def _synthetic(n, ncls, seed):
+    rng = synthetic_rng("cifar", seed)
+    templates = rng.rand(ncls, 3 * 32 * 32).astype(np.float32)
+    labels = rng.randint(0, ncls, n)
+    imgs = np.clip(templates[labels] +
+                   0.25 * rng.rand(n, 3 * 32 * 32).astype(np.float32), 0, 1)
+    return imgs, labels.astype(np.int64)
+
+
+def _reader(n, ncls, seed, fname):
+    def reader():
+        if has_cached("cifar", fname):
+            imgs, labels = load_cached("cifar", fname)
+        else:
+            imgs, labels = _synthetic(n, ncls, seed)
+        for x, y in zip(imgs, labels):
+            yield x, int(y)
+
+    return reader
+
+
+def train10(n=4096):
+    return _reader(n, 10, 0, "train10.pkl")
+
+
+def test10(n=512):
+    return _reader(n, 10, 1, "test10.pkl")
+
+
+def train100(n=4096):
+    return _reader(n, 100, 0, "train100.pkl")
+
+
+def test100(n=512):
+    return _reader(n, 100, 1, "test100.pkl")

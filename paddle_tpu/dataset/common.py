@@ -1,0 +1,48 @@
+"""Dataset plumbing (reference v2/dataset/common.py: DATA_HOME, download
+cache, cluster_files_reader)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"),
+)
+
+
+def cache_path(name: str, fname: str) -> str:
+    return os.path.join(DATA_HOME, name, fname)
+
+
+def has_cached(name: str, fname: str) -> bool:
+    return os.path.exists(cache_path(name, fname))
+
+
+def load_cached(name: str, fname: str):
+    with open(cache_path(name, fname), "rb") as f:
+        return pickle.load(f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=np.load):
+    """Round-robin file sharding across trainers (v2/dataset/common.py) —
+    the host-process data sharding used by multi-host training."""
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            data = loader(fn)
+            for sample in data:
+                yield sample
+
+    return reader
+
+
+def synthetic_rng(name: str, seed_base: int = 0):
+    return np.random.RandomState(abs(hash(name)) % (2**31) + seed_base)

@@ -1,0 +1,43 @@
+"""IMDB sentiment (reference v2/dataset/imdb.py): token-id sequences + 0/1."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+WORD_DICT_SIZE = 5147  # reference imdb word dict size ballpark
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(WORD_DICT_SIZE)}
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("imdb", seed)
+    out = []
+    for _ in range(n):
+        ln = rng.randint(8, 64)
+        label = rng.randint(0, 2)
+        toks = rng.randint(0, WORD_DICT_SIZE // 2, ln) * 2 + label
+        out.append((np.minimum(toks, WORD_DICT_SIZE - 1).astype(np.int64),
+                    label))
+    return out
+
+
+def _reader(n, seed, fname):
+    def reader():
+        data = (load_cached("imdb", fname) if has_cached("imdb", fname)
+                else _synthetic(n, seed))
+        for toks, label in data:
+            yield toks, int(label)
+
+    return reader
+
+
+def train(word_idx=None, n=2048):
+    return _reader(n, 0, "train.pkl")
+
+
+def test(word_idx=None, n=512):
+    return _reader(n, 1, "test.pkl")

@@ -1,0 +1,38 @@
+"""PTB language model n-grams (reference v2/dataset/imikolov.py) — feeds the
+word2vec book test (N-gram next-word prediction)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+DICT_SIZE = 2073  # reference imikolov dict ballpark
+
+
+def build_dict():
+    return {f"w{i}": i for i in range(DICT_SIZE)}
+
+
+def _reader(n, gram, seed, fname):
+    def reader():
+        if has_cached("imikolov", fname):
+            for s in load_cached("imikolov", fname):
+                yield tuple(s)
+            return
+        rng = synthetic_rng("imikolov", seed)
+        # markov-ish synthetic stream: next = (sum of context) % vocab band
+        for _ in range(n):
+            ctx = rng.randint(0, DICT_SIZE, gram - 1)
+            nxt = int(ctx.sum() * 7 % DICT_SIZE)
+            yield tuple(int(c) for c in ctx) + (nxt,)
+
+    return reader
+
+
+def train(word_idx=None, n=4096, gram=5):
+    return _reader(n, gram, 0, "train.pkl")
+
+
+def test(word_idx=None, n=512, gram=5):
+    return _reader(n, gram, 1, "test.pkl")

@@ -1,0 +1,38 @@
+"""MNIST (reference v2/dataset/mnist.py): 28x28 grayscale digits.
+
+Real data if cached (idx files or mnist.pkl), else class-template synthetic."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("mnist", seed)
+    templates = rng.rand(10, 784).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    imgs = np.clip(
+        templates[labels] + 0.25 * rng.rand(n, 784).astype(np.float32), 0, 1)
+    return imgs.astype(np.float32), labels.astype(np.int64)
+
+
+def _reader(n, seed, fname):
+    def reader():
+        if has_cached("mnist", fname):
+            imgs, labels = load_cached("mnist", fname)
+        else:
+            imgs, labels = _synthetic(n, seed)
+        for x, y in zip(imgs, labels):
+            yield x, int(y)
+
+    return reader
+
+
+def train(n=8192):
+    return _reader(n, 0, "train.pkl")
+
+
+def test(n=1024):
+    return _reader(n, 1, "test.pkl")

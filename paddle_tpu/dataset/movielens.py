@@ -1,0 +1,59 @@
+"""MovieLens ratings (reference v2/dataset/movielens.py) — recommender book
+test: (user, gender, age, job, movie, category, title) -> rating."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+USER_COUNT = 6040
+MOVIE_COUNT = 3952
+CATEGORY_COUNT = 18
+AGE_BANDS = 7
+JOB_COUNT = 21
+TITLE_DICT = 1024
+
+
+def max_user_id():
+    return USER_COUNT
+
+
+def max_movie_id():
+    return MOVIE_COUNT
+
+
+def max_job_id():
+    return JOB_COUNT - 1
+
+
+def _reader(n, seed, fname):
+    def reader():
+        if has_cached("movielens", fname):
+            for s in load_cached("movielens", fname):
+                yield tuple(s)
+            return
+        rng = synthetic_rng("movielens", seed)
+        # rating correlates with (user+movie) parity band → learnable signal
+        for _ in range(n):
+            u = rng.randint(0, USER_COUNT)
+            m = rng.randint(0, MOVIE_COUNT)
+            gender = rng.randint(0, 2)
+            age = rng.randint(0, AGE_BANDS)
+            job = rng.randint(0, JOB_COUNT)
+            ncat = rng.randint(1, 4)
+            cats = rng.randint(0, CATEGORY_COUNT, ncat).astype(np.int64)
+            tlen = rng.randint(2, 6)
+            title = rng.randint(0, TITLE_DICT, tlen).astype(np.int64)
+            rating = float((u % 5 + m % 5) % 5 + 1)
+            yield (u, gender, age, job, m, cats, title, rating)
+
+    return reader
+
+
+def train(n=4096):
+    return _reader(n, 0, "train.pkl")
+
+
+def test(n=512):
+    return _reader(n, 1, "test.pkl")

@@ -1,0 +1,35 @@
+"""UCI housing (reference v2/dataset/uci_housing.py): 13 features -> price."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+
+def _data(n, seed):
+    if has_cached("uci_housing", "housing.pkl"):
+        return load_cached("uci_housing", "housing.pkl")
+    rng = synthetic_rng("uci_housing", seed)
+    w = rng.uniform(-1, 1, (13, 1))
+    x = rng.uniform(-1, 1, (n, 13)).astype(np.float32)
+    y = (x @ w + 0.3 + 0.05 * rng.randn(n, 1)).astype(np.float32)
+    return x, y
+
+
+def train(n=404):
+    def reader():
+        x, y = _data(n, 0)
+        for xi, yi in zip(x, y):
+            yield xi, yi
+
+    return reader
+
+
+def test(n=102):
+    def reader():
+        x, y = _data(n, 1)
+        for xi, yi in zip(x, y):
+            yield xi, yi
+
+    return reader

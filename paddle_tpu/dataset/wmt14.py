@@ -1,0 +1,38 @@
+"""WMT14 fr-en (reference v2/dataset/wmt14.py) — NMT book test data:
+(src_ids, tgt_ids_with_bos, tgt_next_ids_with_eos)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+DICT_SIZE = 30000
+BOS, EOS, UNK = 0, 1, 2
+
+
+def _reader(n, dict_size, seed, fname):
+    def reader():
+        if has_cached("wmt14", fname):
+            for s in load_cached("wmt14", fname):
+                yield tuple(s)
+            return
+        rng = synthetic_rng("wmt14", seed)
+        # synthetic 'translation': target = reversed source band-shifted
+        for _ in range(n):
+            ln = rng.randint(3, 12)
+            src = rng.randint(3, dict_size, ln).astype(np.int64)
+            tgt = src[::-1].copy()
+            yield (src,
+                   np.concatenate([[BOS], tgt]).astype(np.int64),
+                   np.concatenate([tgt, [EOS]]).astype(np.int64))
+
+    return reader
+
+
+def train(dict_size=DICT_SIZE, n=2048):
+    return _reader(n, dict_size, 0, "train.pkl")
+
+
+def test(dict_size=DICT_SIZE, n=256):
+    return _reader(n, dict_size, 1, "test.pkl")

@@ -1,0 +1,187 @@
+"""Reader decorators (reference v2/reader/decorator.py)."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+
+def map_readers(func, *readers):
+    """Apply func to samples zipped from readers (decorator.py:29)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    """Pool-shuffle with a bounded buffer (decorator.py:64)."""
+
+    def reader_():
+        rng = _random.Random(seed)
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers):
+    """Concatenate readers (decorator.py:94)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into tuple samples (decorator.py:124)."""
+
+    def _flatten(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in zip(*rs):
+                yield sum((_flatten(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*rs):
+                yield sum((_flatten(i) for i in items if i is not None), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Producer-thread read-ahead (decorator.py:169) — the PyDataProvider2
+    double-buffer idea (gserver/dataproviders/PyDataProvider2.cpp)."""
+
+    end = object()
+
+    def reader_():
+        q = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                return
+            yield s
+
+    return reader_
+
+
+def firstn(reader, n):
+    """Take first n samples (decorator.py:208)."""
+
+    def reader_():
+        return itertools.islice(reader(), n)
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (decorator.py:236)."""
+
+    end = object()
+
+    def reader_():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feeder():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threads = [threading.Thread(target=feeder, daemon=True)]
+        threads += [threading.Thread(target=worker, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return reader_
+
+
+def cache(reader):
+    """Materialize once, replay thereafter."""
+    done = []
+    loaded = [False]
+
+    def reader_():
+        if not loaded[0]:
+            for s in reader():
+                done.append(s)
+                yield s
+            loaded[0] = True
+        else:
+            yield from done
+
+    return reader_
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists (v2/minibatch.py)."""
+
+    def reader_():
+        b = []
+        for s in reader():
+            b.append(s)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return reader_

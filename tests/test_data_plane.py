@@ -1,0 +1,102 @@
+"""Data plane tests: reader decorators, datasets, DataFeeder/DeviceFeeder
+end-to-end with the executor (reference v2/reader/tests + book pipelines)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+from paddle_tpu import dataset
+from paddle_tpu.data_feeder import DataFeeder, DeviceFeeder
+
+
+def test_decorators():
+    r = lambda: iter(range(10))
+    assert list(rd.firstn(r, 3)()) == [0, 1, 2]
+    assert list(rd.map_readers(lambda a, b: a + b, r, r)()) == [
+        2 * i for i in range(10)]
+    assert sorted(rd.shuffle(r, 4, seed=0)()) == list(range(10))
+    assert list(rd.chain(r, r)()) == list(range(10)) * 2
+    assert list(rd.compose(r, r)()) == [(i, i) for i in range(10)]
+    assert list(rd.buffered(r, 2)()) == list(range(10))
+    assert sorted(rd.xmap_readers(lambda x: x * 3, r, 2, 4)()) == [
+        3 * i for i in range(10)]
+    assert list(rd.xmap_readers(lambda x: x * 3, r, 2, 4, order=True)()) == [
+        3 * i for i in range(10)]
+    bs = list(rd.batch(r, 3)())
+    assert bs[0] == [0, 1, 2] and bs[-1] == [9]
+    assert len(list(rd.batch(r, 3, drop_last=True)())) == 3
+
+
+def test_datasets_schema():
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    img, lab = next(dataset.mnist.train()())
+    assert img.shape == (784,) and isinstance(lab, int)
+    toks, label = next(dataset.imdb.train()())
+    assert toks.ndim == 1 and label in (0, 1)
+    src, tgt, tgt_next = next(dataset.wmt14.train()())
+    assert len(tgt) == len(tgt_next) == len(src) + 1
+    sample = next(dataset.movielens.train()())
+    assert len(sample) == 8
+
+
+def test_feeder_end_to_end():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = DataFeeder(feed_list=[x, y])
+    train_reader = rd.batch(
+        rd.shuffle(dataset.uci_housing.train(), 256, seed=0), 64)
+    losses = []
+    for epoch in range(20):
+        for minibatch in train_reader():
+            (l,) = exe.run(feed=feeder.feed(minibatch), fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0]
+    assert losses[-1] < 0.1
+
+
+def test_feeder_lod_sequences():
+    words = fluid.layers.sequence_data(name="w", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[5147, 8])
+    pooled = fluid.layers.sequence_pool(emb, pool_type="average")
+    logits = fluid.layers.fc(input=pooled, size=2)
+    cost = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = DataFeeder(feed_list=[words, label])
+    r = rd.batch(rd.firstn(dataset.imdb.train(), 256), 64)
+    losses = []
+    for _ in range(8):
+        for mb in r():
+            (l,) = exe.run(feed=feeder.feed(mb), fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_device_feeder_prefetch():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    r = rd.batch(dataset.uci_housing.train(), 64)
+    n_batches = 0
+    for staged in DeviceFeeder(feeder, r, depth=2):
+        (l,) = exe.run(feed=staged, fetch_list=[cost])
+        n_batches += 1
+    assert n_batches == len(list(r()))
+    assert np.isfinite(l).all()
