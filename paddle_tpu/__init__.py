@@ -26,7 +26,13 @@ from . import clip  # noqa: F401
 from . import io  # noqa: F401
 from . import nets  # noqa: F401
 from . import compiler  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import profiler  # noqa: F401
+from . import learning_rate_decay  # noqa: F401
+from . import reader  # noqa: F401
+from .data_feeder import DataFeeder, DeviceFeeder  # noqa: F401
 from .lod import LoDTensor  # noqa: F401
+from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
 from .framework.backward import append_backward  # noqa: F401

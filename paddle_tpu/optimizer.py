@@ -27,7 +27,13 @@ class Optimizer:
 
     # ------------------------------------------------------------------
     def _create_lr_var(self, block):
+        from .framework.core import Variable
+
         self.helper = LayerHelper(type(self).__name__.lower())
+        if isinstance(self._lr_value, Variable):
+            # a schedule built by learning_rate_decay.* — already ops in-graph
+            self._lr_var = self._lr_value
+            return self._lr_var
         lr = self.helper.create_global_variable(
             name=unique_name.generate("learning_rate"),
             shape=(1,), dtype="float32")

@@ -1,0 +1,113 @@
+"""Profiler (reference paddle/platform/profiler.h Event/RecordEvent RAII +
+EventItem report, python/paddle/v2/fluid/profiler.py cuda_profiler :32).
+
+Two layers, matching the reference's two:
+  - host event timers: `RecordEvent` context manager accumulating wall time
+    per name into a global report (the reference's Stat/REGISTER_TIMER and
+    Event/EventList), printable via `print_report()`;
+  - device tracing: `profiler()` context manager wrapping `jax.profiler`
+    traces — the XLA/TPU analog of nvprof hooks — producing a TensorBoard-
+    loadable trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+_lock = threading.Lock()
+_events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # n, total, max, min
+_enabled = [False]
+
+
+def enable_profiler():
+    _enabled[0] = True
+
+
+def disable_profiler():
+    _enabled[0] = False
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+class RecordEvent:
+    """RAII timer (profiler.h:102). Usable as context manager/decorator."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        with _lock:
+            e = _events[self.name]
+            e[0] += 1
+            e[1] += dt
+            e[2] = max(e[2], dt)
+            e[3] = min(e[3], dt)
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def get_report():
+    """EventItem aggregation (profiler.cc report): name → stats dict."""
+    with _lock:
+        return {
+            name: {"calls": n, "total_s": tot, "avg_s": tot / max(n, 1),
+                   "max_s": mx, "min_s": mn if n else 0.0}
+            for name, (n, tot, mx, mn) in _events.items()
+        }
+
+
+def print_report(sorted_by="total_s"):
+    rep = get_report()
+    rows = sorted(rep.items(), key=lambda kv: -kv[1][sorted_by])
+    print(f"{'Event':40s} {'Calls':>8s} {'Total(s)':>10s} {'Avg(ms)':>10s} "
+          f"{'Max(ms)':>10s}")
+    for name, s in rows:
+        print(f"{name:40s} {s['calls']:8d} {s['total_s']:10.4f} "
+              f"{s['avg_s']*1e3:10.3f} {s['max_s']*1e3:10.3f}")
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """fluid.profiler.profiler context: host timers + optional device trace.
+
+    With trace_dir set, wraps jax.profiler.trace (XLA's on-device profiler —
+    the TPU analog of the reference's cuda_profiler nvprof hooks)."""
+    import jax
+
+    reset_profiler()
+    enable_profiler()
+    ctx = (jax.profiler.trace(trace_dir) if trace_dir
+           else contextlib.nullcontext())
+    with ctx:
+        t0 = time.perf_counter()
+        yield
+        _ = time.perf_counter() - t0
+    disable_profiler()
+    if sorted_key:
+        print_report({"calls": "calls", "total": "total_s",
+                      "ave": "avg_s", "max": "max_s"}.get(sorted_key,
+                                                          "total_s"))
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):
+    """API-parity shim for fluid.profiler.cuda_profiler (profiler.py:32):
+    device tracing on TPU goes through `profiler(trace_dir=...)`."""
+    yield

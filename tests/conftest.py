@@ -17,6 +17,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# float64 available for numeric-gradient op tests (reference op_test.py:96
+# get_numeric_gradient uses double-precision central differences)
+jax.config.update("jax_enable_x64", True)
 if len(jax.devices()) < 8:  # platform was pinned before we got here
     from jax._src import xla_bridge
 

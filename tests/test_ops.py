@@ -1,0 +1,286 @@
+"""Per-op numeric tests via the OpTest harness (the reference's
+test_*_op.py battery, fluid/tests/test_mul_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RNG = np.random.RandomState(7)
+
+
+def _r(*shape):
+    return RNG.uniform(0.1, 1.0, shape).astype(np.float64)
+
+
+# --- outputs ---------------------------------------------------------------
+
+
+def test_mul_output_and_grad():
+    x, y = _r(3, 4), _r(4, 5)
+    t = OpTestHarness("mul", {"X": x, "Y": y},
+                      {"x_num_col_dims": 1, "y_num_col_dims": 1})
+    t.check_output({"Out": x @ y})
+    t.check_grad(["X", "Y"])
+
+
+def test_mul_flatten_dims():
+    x, y = _r(2, 3, 4), _r(4, 5)
+    t = OpTestHarness("mul", {"X": x, "Y": y},
+                      {"x_num_col_dims": 2, "y_num_col_dims": 1})
+    t.check_output({"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)})
+    t.check_grad(["X"])
+
+
+def test_matmul_transpose():
+    x, y = _r(4, 3), _r(5, 3)
+    t = OpTestHarness("matmul", {"X": x, "Y": y}, {"transpose_Y": True})
+    t.check_output({"Out": x @ y.T})
+    t.check_grad(["X", "Y"])
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_add", np.add),
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+])
+def test_elementwise(op, fn):
+    x, y = _r(3, 4), _r(3, 4)
+    t = OpTestHarness(op, {"X": x, "Y": y})
+    t.check_output({"Out": fn(x, y)})
+    t.check_grad(["X", "Y"])
+
+
+def test_elementwise_add_axis_broadcast():
+    x, y = _r(2, 3, 4), _r(3)
+    t = OpTestHarness("elementwise_add", {"X": x, "Y": y}, {"axis": 1})
+    t.check_output({"Out": x + y[None, :, None]})
+    t.check_grad(["X", "Y"])
+
+
+def test_sum_multi_input():
+    xs = [_r(3, 3), _r(3, 3), _r(3, 3)]
+    t = OpTestHarness("sum", {"X": xs})
+    t.check_output({"Out": xs[0] + xs[1] + xs[2]})
+    t.check_grad(["X"])
+
+
+def test_scale():
+    x = _r(3, 4)
+    t = OpTestHarness("scale", {"X": x}, {"scale": 2.5, "bias": 0.5})
+    t.check_output({"Out": 2.5 * x + 0.5})
+    t.check_grad(["X"])
+
+
+def test_mean():
+    x = _r(3, 4)
+    t = OpTestHarness("mean", {"X": x})
+    t.check_output({"Out": np.asarray([x.mean()])})
+    t.check_grad(["X"])
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("log", np.log),
+    ("sqrt", np.sqrt),
+    ("square", np.square),
+    ("relu", lambda v: np.maximum(v, 0)),
+    ("softplus", lambda v: np.log1p(np.exp(v))),
+    ("reciprocal", lambda v: 1 / v),
+    ("abs", np.abs),
+])
+def test_activation(op, fn):
+    x = _r(3, 4) + 0.5  # keep away from kinks/singularities
+    t = OpTestHarness(op, {"X": x})
+    t.check_output({"Out": fn(x)})
+    t.check_grad(["X"], max_relative_error=1e-2)
+
+
+def test_softmax():
+    x = _r(4, 6)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    t = OpTestHarness("softmax", {"X": x})
+    t.check_output({"Out": e / e.sum(-1, keepdims=True)})
+    t.check_grad(["X"])
+
+
+def test_cross_entropy_grad():
+    probs = RNG.dirichlet(np.ones(5), size=4)
+    labels = RNG.randint(0, 5, (4, 1)).astype(np.int64)
+    t = OpTestHarness("cross_entropy", {"X": probs, "Label": labels},
+                      out_slots=["Y"])
+    want = -np.log(probs[np.arange(4), labels.ravel()])[:, None]
+    t.check_output({"Y": want})
+    t.check_grad(["X"], output_slot="Y")
+
+
+def test_softmax_with_cross_entropy_grad():
+    logits = _r(4, 5)
+    labels = RNG.randint(0, 5, (4, 1)).astype(np.int64)
+    t = OpTestHarness("softmax_with_cross_entropy",
+                      {"Logits": logits, "Label": labels},
+                      out_slots=["Loss", "Softmax"])
+    t.check_grad(["Logits"], output_slot="Loss")
+
+
+@pytest.mark.parametrize("op,npfn", [
+    ("reduce_sum", np.sum),
+    ("reduce_mean", np.mean),
+    ("reduce_max", np.max),
+])
+def test_reduce(op, npfn):
+    x = _r(3, 4, 5)
+    t = OpTestHarness(op, {"X": x}, {"dim": 1})
+    t.check_output({"Out": npfn(x, axis=1)})
+    if op != "reduce_max":
+        t.check_grad(["X"])
+
+
+def test_concat_grad():
+    xs = [_r(2, 3), _r(2, 4)]
+    t = OpTestHarness("concat", {"X": xs}, {"axis": 1})
+    t.check_output({"Out": np.concatenate(xs, axis=1)})
+    t.check_grad(["X"])
+
+
+def test_reshape_transpose_grad():
+    x = _r(2, 6)
+    t = OpTestHarness("reshape", {"X": x}, {"shape": [3, 4]})
+    t.check_output({"Out": x.reshape(3, 4)})
+    t.check_grad(["X"])
+    t2 = OpTestHarness("transpose", {"X": x}, {"axis": [1, 0]})
+    t2.check_output({"Out": x.T})
+    t2.check_grad(["X"])
+
+
+def test_pad_slice_gather():
+    x = _r(2, 3)
+    t = OpTestHarness("pad", {"X": x}, {"paddings": [0, 1, 1, 0],
+                                        "pad_value": 0.0})
+    t.check_output({"Out": np.pad(x, ((0, 1), (1, 0)))})
+    t.check_grad(["X"])
+
+    t2 = OpTestHarness("slice", {"Input": x},
+                       {"axes": [1], "starts": [1], "ends": [3]})
+    t2.check_output({"Out": x[:, 1:3]})
+
+    idx = np.asarray([1, 0, 1], dtype=np.int64)
+    t3 = OpTestHarness("gather", {"X": x, "Index": idx})
+    t3.check_output({"Out": x[idx]})
+    t3.check_grad(["X"])
+
+
+def test_lookup_table_grad():
+    w = _r(10, 4)
+    ids = np.asarray([[1], [3], [1]], dtype=np.int64)
+    t = OpTestHarness("lookup_table", {"W": w, "Ids": ids},
+                      {"padding_idx": -1})
+    t.check_output({"Out": w[ids.ravel()]})
+    t.check_grad(["W"])
+
+
+def test_conv2d_output_and_grad():
+    x = _r(1, 2, 5, 5)
+    w = _r(3, 2, 3, 3)
+    t = OpTestHarness("conv2d", {"Input": x, "Filter": w},
+                      {"strides": [1, 1], "paddings": [1, 1],
+                       "dilations": [1, 1], "groups": 1},
+                      out_slots=["Output"])
+    # numpy reference conv
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    want = np.zeros((1, 3, 5, 5))
+    for o in range(3):
+        for i in range(5):
+            for j in range(5):
+                want[0, o, i, j] = np.sum(xp[0, :, i:i+3, j:j+3] * w[o])
+    t.check_output({"Output": want}, atol=1e-8)
+    t.check_grad(["Input", "Filter"], output_slot="Output",
+                 max_relative_error=1e-2)
+
+
+def test_pool2d_avg_grad():
+    x = _r(1, 1, 4, 4)
+    t = OpTestHarness("pool2d", {"X": x},
+                      {"pooling_type": "avg", "ksize": [2, 2],
+                       "strides": [2, 2], "paddings": [0, 0]})
+    want = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    t.check_output({"Out": want})
+    t.check_grad(["X"])
+
+
+def test_pool2d_max():
+    x = _r(1, 1, 4, 4)
+    t = OpTestHarness("pool2d", {"X": x},
+                      {"pooling_type": "max", "ksize": [2, 2],
+                       "strides": [2, 2], "paddings": [0, 0]})
+    want = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    t.check_output({"Out": want})
+
+
+def test_clip_grad():
+    x = _r(3, 3)
+    t = OpTestHarness("clip", {"X": x}, {"min": 0.3, "max": 0.7})
+    t.check_output({"Out": np.clip(x, 0.3, 0.7)})
+
+
+def test_top_k():
+    x = _r(3, 6)
+    t = OpTestHarness("top_k", {"X": x}, {"k": 2},
+                      out_slots=["Out", "Indices"])
+    want = np.sort(x, axis=-1)[:, ::-1][:, :2]
+    t.check_output({"Out": want})
+
+
+def test_sequence_pool_grad():
+    x = _r(2, 4, 3)
+    lens = np.asarray([2, 4], dtype=np.int32)
+    t = OpTestHarness("sequence_pool", {"X": x, "Length": lens},
+                      {"pooltype": "sum"})
+    m = (np.arange(4)[None, :] < lens[:, None]).astype(x.dtype)
+    t.check_output({"Out": (x * m[..., None]).sum(1)})
+    t.check_grad(["X"])
+
+
+def test_lstm_gru_grad_small():
+    B, T, H = 2, 3, 4
+    x = _r(B, T, 4 * H) * 0.2
+    w = _r(H, 4 * H) * 0.2
+    lens = np.asarray([2, 3], dtype=np.int32)
+    t = OpTestHarness("lstm", {"Input": x, "Weight": w, "Length": lens},
+                      out_slots=["Hidden", "Cell"])
+    t.check_grad(["Input", "Weight"], output_slot="Hidden",
+                 max_relative_error=1e-2)
+
+    xg = _r(B, T, 3 * H) * 0.2
+    wg = _r(H, 3 * H) * 0.2
+    t2 = OpTestHarness("gru", {"Input": xg, "Weight": wg, "Length": lens},
+                       out_slots=["Hidden"])
+    t2.check_grad(["Input", "Weight"], output_slot="Hidden",
+                  max_relative_error=1e-2)
+
+
+def test_layer_norm_grad():
+    x = _r(3, 6)
+    s, b = _r(6), _r(6)
+    t = OpTestHarness("layer_norm", {"X": x, "Scale": s, "Bias": b},
+                      {"begin_norm_axis": 1}, out_slots=["Y"])
+    t.check_grad(["X", "Scale", "Bias"], output_slot="Y",
+                 max_relative_error=1e-2)
+
+
+def test_batch_norm_infer_output():
+    x = _r(2, 3, 2, 2)
+    scale, bias = _r(3), _r(3)
+    mean, var = np.zeros(3), np.ones(3)
+    t = OpTestHarness("batch_norm",
+                      {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var},
+                      {"is_test": True, "epsilon": 1e-5},
+                      out_slots=["Y", "MeanOut", "VarianceOut",
+                                 "SavedMean", "SavedVariance"])
+    want = (x / np.sqrt(1 + 1e-5)) * scale[None, :, None, None] \
+        + bias[None, :, None, None]
+    t.check_output({"Y": want}, atol=1e-4)
