@@ -1,0 +1,12 @@
+from .checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from .launch import init_distributed, env_trainer_count, env_trainer_id, shard_reader  # noqa: F401
+from .master import (  # noqa: F401
+    MasterClient,
+    MasterServer,
+    MasterService,
+    master_reader,
+)

@@ -1,0 +1,94 @@
+"""Trainer checkpoint/resume without losing data-epoch position
+(SURVEY.md §7 hard part (f); reference go/pserver/service.go:120-227/346
+checkpoints + master snapshot, fluid save/load_persistables).
+
+A checkpoint = model+optimizer persistables (io.save_persistables) + trainer
+progress (pass/step counters, RNG step) + optionally the master task-queue
+snapshot, written atomically (tmp+rename, the Go pserver's pattern) with an
+md5-style integrity digest in the meta (service.go uses md5+etcd meta)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Optional
+
+from .. import io as fio
+from ..framework.scope import global_scope
+
+
+def _digest(dirname) -> str:
+    h = hashlib.md5()
+    for fn in sorted(os.listdir(dirname)):
+        if fn.endswith(".npy"):
+            with open(os.path.join(dirname, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def save_checkpoint(executor, dirname, main_program=None, trainer_state=None,
+                    master: Optional[object] = None, keep: int = 3):
+    """Write checkpoint dir `<dirname>/ckpt_<n>` + update LATEST pointer."""
+    os.makedirs(dirname, exist_ok=True)
+    existing = sorted(
+        int(d.split("_")[1]) for d in os.listdir(dirname)
+        if d.startswith("ckpt_"))
+    n = (existing[-1] + 1) if existing else 0
+    tmp = os.path.join(dirname, f".tmp_ckpt_{n}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    fio.save_persistables(executor, tmp, main_program)
+    if master is not None:
+        master.snapshot_path = os.path.join(tmp, "master_queue.json")
+        master.snapshot()
+    meta = {
+        "version": n,
+        "time": time.time(),
+        "trainer_state": trainer_state or {},
+        "digest": _digest(tmp),
+    }
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    final = os.path.join(dirname, f"ckpt_{n}")
+    os.replace(tmp, final)
+    with open(os.path.join(dirname, "LATEST.tmp"), "w") as f:
+        f.write(str(n))
+    os.replace(os.path.join(dirname, "LATEST.tmp"),
+               os.path.join(dirname, "LATEST"))
+    # retention
+    for old in existing[: max(0, len(existing) - keep + 1)]:
+        shutil.rmtree(os.path.join(dirname, f"ckpt_{old}"),
+                      ignore_errors=True)
+    return final
+
+
+def latest_checkpoint(dirname) -> Optional[str]:
+    latest = os.path.join(dirname, "LATEST")
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        n = int(f.read().strip())
+    path = os.path.join(dirname, f"ckpt_{n}")
+    return path if os.path.exists(path) else None
+
+
+def load_checkpoint(executor, dirname, main_program=None,
+                    master: Optional[object] = None,
+                    verify_digest: bool = True):
+    """Restore the newest checkpoint → trainer_state dict (or None)."""
+    path = latest_checkpoint(dirname)
+    if path is None:
+        return None
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if verify_digest and meta["digest"] != _digest(path):
+        raise IOError(f"checkpoint {path} failed integrity check")
+    fio.load_persistables(executor, path, main_program)
+    mq = os.path.join(path, "master_queue.json")
+    if master is not None and os.path.exists(mq):
+        master.snapshot_path = mq
+        master.recover()
+    return meta["trainer_state"]

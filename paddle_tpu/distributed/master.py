@@ -1,0 +1,261 @@
+"""Elastic data-dispatch master (reference go/master/service.go).
+
+The Go master shards a dataset into tasks (partition :106), serves GetTask
+(:368) / TaskFinished (:411) / TaskFailed (:455) to trainers, requeues on
+timeout (checkTimeoutFunc :341), caps per-task failures (processFailedTask
+:313), and snapshots queue state to etcd (:207) for leader-failover recovery
+(:166).
+
+Here the data plane that the Go master fed (pserver trainers) is gone — SPMD
+training reads data per host process — but the *elastic dispatch* capability
+remains useful for multi-host input sharding and straggler tolerance.  The
+service is plain Python (it is control plane, not compute): in-process use
+for tests, JSON-lines-over-TCP for multi-process, snapshot to a file standing
+in for etcd."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Task:
+    task_id: int
+    payload: object  # opaque descriptor: file path, index range, chunk
+    epoch: int = 0
+    num_failures: int = 0
+
+
+class MasterService:
+    """In-process task queue with timeout requeue and failure caps."""
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3,
+                 snapshot_path: Optional[str] = None):
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self._lock = threading.Lock()
+        self._todo: List[Task] = []
+        self._pending: Dict[int, tuple] = {}  # id -> (Task, deadline)
+        self._done: List[Task] = []
+        self._epoch = 0
+        self._next_id = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self.recover()
+
+    # -- dataset ------------------------------------------------------------
+    def set_dataset(self, payloads: List[object]):
+        with self._lock:
+            self._todo = [Task(self._take_id(), p) for p in payloads]
+            self._pending.clear()
+            self._done.clear()
+            self._snapshot_locked()
+
+    def _take_id(self) -> int:
+        self._next_id += 1
+        return self._next_id - 1
+
+    # -- trainer RPCs (service.go:368/411/455) ------------------------------
+    def get_task(self, trainer_id: str = "") -> Optional[dict]:
+        with self._lock:
+            self._requeue_timeouts_locked()
+            if not self._todo:
+                if not self._pending and self._done:
+                    # epoch finished → recycle for the next pass
+                    self._epoch += 1
+                    self._todo = [
+                        Task(t.task_id, t.payload, self._epoch)
+                        for t in self._done
+                    ]
+                    self._done = []
+                else:
+                    return None
+            t = self._todo.pop(0)
+            self._pending[t.task_id] = (t, time.time() + self.timeout_s)
+            self._snapshot_locked()
+            return {"task_id": t.task_id, "payload": t.payload,
+                    "epoch": t.epoch}
+
+    def task_finished(self, task_id: int):
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is not None:
+                self._done.append(ent[0])
+            self._snapshot_locked()
+
+    def task_failed(self, task_id: int):
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return
+            t = ent[0]
+            t.num_failures += 1
+            if t.num_failures < self.failure_max:
+                self._todo.append(t)  # requeue (processFailedTask :313)
+            else:
+                self._done.append(t)  # drop after failure_max, logged as done
+            self._snapshot_locked()
+
+    def _requeue_timeouts_locked(self):
+        now = time.time()
+        for tid in [tid for tid, (_, dl) in self._pending.items()
+                    if dl < now]:
+            t, _ = self._pending.pop(tid)
+            t.num_failures += 1
+            if t.num_failures < self.failure_max:
+                self._todo.append(t)
+            else:
+                self._done.append(t)
+
+    # -- introspection ------------------------------------------------------
+    def progress(self) -> dict:
+        with self._lock:
+            return {"epoch": self._epoch, "todo": len(self._todo),
+                    "pending": len(self._pending), "done": len(self._done)}
+
+    # -- snapshot/recover (service.go:207/:166; etcd → file) ----------------
+    def _snapshot_locked(self):
+        if not self.snapshot_path:
+            return
+        state = {
+            "epoch": self._epoch,
+            "next_id": self._next_id,
+            "todo": [(t.task_id, t.payload, t.epoch, t.num_failures)
+                     for t in self._todo] +
+                    [(t.task_id, t.payload, t.epoch, t.num_failures)
+                     for t, _ in self._pending.values()],
+            "done": [(t.task_id, t.payload, t.epoch, t.num_failures)
+                     for t in self._done],
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def snapshot(self):
+        with self._lock:
+            self._snapshot_locked()
+
+    def recover(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        with self._lock:
+            self._epoch = state["epoch"]
+            self._next_id = state["next_id"]
+            # pending tasks at snapshot time were not finished → back to todo
+            self._todo = [Task(i, p, e, nf) for i, p, e, nf in state["todo"]]
+            self._pending = {}
+            self._done = [Task(i, p, e, nf) for i, p, e, nf in state["done"]]
+
+
+# ---------------------------------------------------------------------------
+# TCP transport: JSON-lines RPC (thin stand-in for go net/rpc + etcd)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        svc: MasterService = self.server.service  # type: ignore
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                method = req["method"]
+                args = req.get("args", [])
+                result = getattr(svc, method)(*args)
+                resp = {"ok": True, "result": result}
+            except Exception as e:  # report, keep serving
+                resp = {"ok": False, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    def __init__(self, service: MasterService, host="127.0.0.1", port=0):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.service = service  # type: ignore
+        self.addr = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient:
+    """Trainer-side client (go/master/client.go + python v2/master/client.py
+    :28/:70) with reconnect-on-error."""
+
+    def __init__(self, addr, retries: int = 3):
+        self.addr = tuple(addr)
+        self.retries = retries
+        self._sock = None
+        self._file = None
+
+    def _connect(self):
+        self._sock = socket.create_connection(self.addr, timeout=30)
+        self._file = self._sock.makefile("rwb")
+
+    def call(self, method, *args):
+        last = None
+        for _ in range(self.retries):
+            try:
+                if self._file is None:
+                    self._connect()
+                self._file.write(
+                    (json.dumps({"method": method, "args": list(args)})
+                     + "\n").encode())
+                self._file.flush()
+                resp = json.loads(self._file.readline())
+                if not resp["ok"]:
+                    raise RuntimeError(resp["error"])
+                return resp["result"]
+            except (OSError, ValueError) as e:
+                last = e
+                self._file = None
+                time.sleep(0.1)
+        raise ConnectionError(f"master unreachable: {last}")
+
+    def get_task(self, trainer_id=""):
+        return self.call("get_task", trainer_id)
+
+    def task_finished(self, task_id):
+        return self.call("task_finished", task_id)
+
+    def task_failed(self, task_id):
+        return self.call("task_failed", task_id)
+
+    def progress(self):
+        return self.call("progress")
+
+
+def master_reader(client: MasterClient, load_task, trainer_id=""):
+    """Reader over master-dispatched tasks (the v2 cluster reader pattern:
+    dataset/common.py master-client integration): pulls tasks, yields their
+    samples, acks; on loader failure reports task_failed and moves on."""
+
+    def reader():
+        while True:
+            task = client.get_task(trainer_id)
+            if task is None:
+                return
+            try:
+                yield from load_task(task["payload"])
+            except Exception:
+                client.task_failed(task["task_id"])
+                continue
+            client.task_finished(task["task_id"])
+
+    return reader

@@ -1,0 +1,9 @@
+"""Native (C++) host-runtime components.
+
+The reference keeps its data plane and runtime native (SURVEY.md §2); here the
+device compute path is XLA-generated, and the native layer covers host-side
+plumbing. Currently: recordio (chunked CRC record storage). Libraries build
+on demand with g++ (build_lib) and bind via ctypes; every component has a
+pure-Python fallback producing byte-identical formats."""
+
+from . import recordio  # noqa: F401
