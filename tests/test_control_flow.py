@@ -1,0 +1,133 @@
+"""Control-flow tests: While, StaticRNN (trainable), DynamicRNN with ragged
+lengths, ifelse, tensor arrays (reference fluid tests test_while_op,
+test_recurrent_op, test_dyn_rnn, test_array_read_write)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDTensor
+
+
+def test_while_loop_accumulates():
+    # sum integers 0..9 with a while loop
+    i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10)
+    total = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with w.block():
+        new_total = fluid.layers.elementwise_add(total, i)
+        fluid.layers.assign(new_total, total)
+        fluid.layers.increment(i, 1.0)
+        fluid.layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(feed={}, fetch_list=[total])
+    assert float(res.item()) == sum(range(10))
+
+
+def test_static_rnn_trains():
+    """Hand-built RNN cell via StaticRNN must train (grads through scan +
+    sub-block externals)."""
+    H = 16
+    x = fluid.layers.data(name="x", shape=[5, 8], dtype="float32")  # [B,5,8]
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)  # [B,8]
+        h_prev = rnn.memory(shape=[H], batch_ref=x)
+        h = fluid.layers.fc(input=[x_t, h_prev], size=H, act="tanh")
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    hidden_seq = rnn()  # [B,5,H]
+
+    last = fluid.layers.reshape(hidden_seq, [-1, 5 * H])
+    logits = fluid.layers.fc(input=last, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, (64, 1)).astype(np.int64)
+    xs = rng.rand(64, 5, 8).astype(np.float32) + labels[:, :, None] * 0.5
+    losses = []
+    for _ in range(15):
+        (l,) = exe.run(feed={"x": xs, "y": labels}, fetch_list=[loss])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_dynamic_rnn_ragged():
+    """DynamicRNN over ragged sequences: states freeze past each sequence's
+    end (shrink_rnn_memory semantics)."""
+    H = 8
+    x = fluid.layers.sequence_data(name="x", shape=[4], dtype="float32")
+    rnn = fluid.layers.DynamicRNN()
+    with rnn.block():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[H], batch_ref=x)
+        h = fluid.layers.fc(input=[x_t, h_prev], size=H, act="relu")
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs = [np.ones((2, 4), np.float32), np.ones((5, 4), np.float32)]
+    (res,) = exe.run(feed={"x": LoDTensor.from_sequences(seqs)},
+                     fetch_list=[out])
+    # first sequence has length 2: padded steps >=2 are zero (LoD semantics)
+    np.testing.assert_allclose(res[0, 2:], 0.0)
+    assert np.abs(res[0, :2]).sum() > 0
+    # second sequence evolves through all 5 true steps
+    assert np.abs(res[1, 4]).sum() > 0
+    assert not np.allclose(res[1, 4], res[1, 1])
+
+
+def test_ifelse_differentiable():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    flag = fluid.layers.data(name="flag", shape=[1], dtype="float32",
+                             append_batch_size=False)
+
+    def true_branch():
+        return [fluid.layers.scale(x, scale=2.0)]
+
+    def false_branch():
+        return [fluid.layers.scale(x, scale=-1.0)]
+
+    out = fluid.layers.ifelse(flag, true_branch, false_branch)
+    s = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 4), np.float32)
+    (r1,) = exe.run(feed={"x": xv, "flag": np.asarray([1.0], np.float32)},
+                    fetch_list=[s])
+    (r0,) = exe.run(feed={"x": xv, "flag": np.asarray([0.0], np.float32)},
+                    fetch_list=[s])
+    assert float(r1.item()) == 2.0
+    assert float(r0.item()) == -1.0
+
+
+def test_array_ops_roundtrip():
+    arr = fluid.layers.fill_constant(shape=[4, 3], dtype="float32", value=0)
+    block = fluid.default_main_program().global_block()
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                          append_batch_size=False)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int32", value=2)
+    written = fluid.layers.fill_constant(shape=[4, 3], dtype="float32",
+                                         value=0)
+    block.append_op("array_write",
+                    inputs={"Array": [arr.name], "X": [x.name],
+                            "I": [i.name]},
+                    outputs={"Out": [written.name]})
+    read = fluid.layers.fill_constant(shape=[3], dtype="float32", value=0)
+    block.append_op("array_read",
+                    inputs={"Array": [written.name], "I": [i.name]},
+                    outputs={"Out": [read.name]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.asarray([1.0, 2.0, 3.0], np.float32)
+    w, r = exe.run(feed={"x": xv}, fetch_list=[written, read])
+    np.testing.assert_allclose(w[2], xv)
+    np.testing.assert_allclose(w[0], 0)
+    np.testing.assert_allclose(r, xv)
