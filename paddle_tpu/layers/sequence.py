@@ -175,6 +175,43 @@ def dynamic_lstm(input, size, h0=None, c0=None, param_attr=None,
     return hidden, cell
 
 
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF loss layer (fluid nn.py linear_chain_crf): input [B,T,C] emission,
+    label [B,T,1] → per-sequence negative log-likelihood [B,1]. The
+    transition parameter is named for reuse by crf_decoding."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    length = get_length_var(input)
+    C = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=param_attr if isinstance(param_attr, dict) else {},
+        shape=[C + 2, C], dtype="float32")
+    nll = helper.create_tmp_variable("float32")
+    alpha = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op(
+        "linear_chain_crf",
+        inputs={"Emission": [input.name], "Transition": [transition.name],
+                "Label": [label.name], "Length": [length.name]},
+        outputs={"LogLikelihood": [nll.name], "Alpha": [alpha.name]},
+    )
+    nll._crf_transition = transition
+    return nll
+
+
+def crf_decoding(input, transition):
+    """Viterbi decode layer: input [B,T,C] + the CRF's transition param →
+    ViterbiPath [B,T] int32."""
+    helper = LayerHelper("crf_decoding")
+    length = get_length_var(input)
+    path = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(
+        "crf_decoding",
+        inputs={"Emission": [input.name], "Transition": [transition.name],
+                "Length": [length.name]},
+        outputs={"ViterbiPath": [path.name]},
+    )
+    return propagate_length(input, path)
+
+
 def dynamic_gru(input, size, h0=None, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation="sigmoid",
                 candidate_activation="tanh"):

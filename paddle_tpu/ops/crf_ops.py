@@ -1,0 +1,122 @@
+"""Linear-chain CRF ops (reference operators/linear_chain_crf_op.cc +
+crf_decoding_op.cc — the heart of the label_semantic_roles book model, and
+the v1 CRFLayer/CRFDecodingLayer pair).
+
+Paddle transition layout preserved: Transition[(ncls+2), ncls] where row 0 =
+start weights, row 1 = end weights, rows 2: = [from, to] matrix.  The
+reference computes forward-algorithm alpha per LoD sequence on CPU; here the
+forward recursion is one lax.scan over the padded time axis with masks —
+differentiable end to end, so CRF training needs no custom grad kernel."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _split_transition(transition):
+    start = transition[0]
+    end = transition[1]
+    trans = transition[2:]
+    return start, end, trans
+
+
+@register_op("linear_chain_crf", non_diff_inputs=("Label", "Length"),
+             non_diff_outputs=("Alpha",))
+def linear_chain_crf(ctx, ins, attrs):
+    """Inputs: Emission [B,T,C], Transition [(C+2),C], Label [B,T,1] int,
+    Length [B]. Output LogLikelihood [B,1] (negative log-lik, i.e. the loss
+    per sequence, matching the reference's -log p(label|x))."""
+    import jax
+    import jax.numpy as jnp
+
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0].astype(jnp.float32)
+    label = ins["Label"][0]
+    lengths = ins["Length"][0]
+    B, T, C = emission.shape
+    start_w, end_w, trans = _split_transition(transition)
+    lab = label.reshape(B, T).astype(jnp.int32)
+    tmask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+    # ---- log Z by forward algorithm ----
+    alpha0 = start_w[None, :] + emission[:, 0]  # [B,C]
+
+    def fwd(alpha, t):
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i,j]) + emission[t,j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + emission[:, t]
+        mt = tmask[:, t][:, None]
+        return mt * new + (1 - mt) * alpha, None
+
+    alpha_T, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    logZ = jax.nn.logsumexp(alpha_T + end_w[None, :], axis=1)  # [B]
+
+    # ---- gold path score ----
+    first_score = start_w[lab[:, 0]] + emission[:, 0][
+        jnp.arange(B), lab[:, 0]]
+
+    def gold(carry, t):
+        prev_lab = lab[:, t - 1]
+        cur_lab = lab[:, t]
+        step = trans[prev_lab, cur_lab] + emission[:, t][
+            jnp.arange(B), cur_lab]
+        return carry + tmask[:, t] * step, None
+
+    path, _ = jax.lax.scan(gold, first_score, jnp.arange(1, T))
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_lab = lab[jnp.arange(B), last_idx]
+    path = path + end_w[last_lab]
+
+    nll = (logZ - path)[:, None]
+    return {"LogLikelihood": [nll], "Alpha": [alpha_T]}
+
+
+@register_op("crf_decoding", grad=None)
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode: Emission [B,T,C] + Transition + Length →
+    ViterbiPath [B,T] int32 (zeros past each length), and if Label given,
+    per-token correctness like the reference's constrained output."""
+    import jax
+    import jax.numpy as jnp
+
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0].astype(jnp.float32)
+    lengths = ins["Length"][0]
+    B, T, C = emission.shape
+    start_w, end_w, trans = _split_transition(transition)
+    tmask = (jnp.arange(T)[None, :] < lengths[:, None])
+
+    delta0 = start_w[None, :] + emission[:, 0]
+
+    def viterbi(delta, t):
+        scores = delta[:, :, None] + trans[None, :, :]  # [B,from,to]
+        best = jnp.max(scores, axis=1) + emission[:, t]
+        back = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B,to]
+        mt = tmask[:, t][:, None]
+        new = jnp.where(mt, best, delta)
+        return new, back
+
+    delta_T, backs = jax.lax.scan(viterbi, delta0, jnp.arange(1, T))
+    # add end weights at each sequence's true last position
+    final = delta_T + end_w[None, :]
+    last_state = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+
+    # backtrack from each b's length-1 down to 0
+    def backtrack(state, t_rev):
+        # t_rev runs T-2 .. 0 ; backs[t_rev] maps step t_rev+1
+        bt = backs[t_rev]  # [B,C]
+        prev = bt[jnp.arange(B), state]
+        # only follow pointers for positions within the sequence
+        inside = (t_rev + 1) < lengths
+        new_state = jnp.where(inside, prev, state)
+        return new_state, new_state
+
+    # states at positions T-1..0 (reversed emission order)
+    state_T = last_state
+    _, rev_states = jax.lax.scan(backtrack, state_T,
+                                 jnp.arange(T - 2, -1, -1))
+    # path = [pos0..pos_{T-1}]
+    path = jnp.concatenate(
+        [rev_states[::-1].T, last_state[:, None]], axis=1)  # [B,T]
+    path = jnp.where(tmask, path, 0)
+    return {"ViterbiPath": [path.astype(jnp.int32)]}

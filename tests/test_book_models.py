@@ -1,0 +1,194 @@
+"""Remaining book acceptance tests (reference fluid/tests/book/):
+word2vec, recommender_system, image_classification (VGG cifar),
+label_semantic_roles (CRF), plus the CTR DeepFM config from BASELINE.json."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset
+from paddle_tpu import reader as rd
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.lod import LoDTensor
+from paddle_tpu.models import deepfm, vgg
+
+
+def test_word2vec():
+    """test_word2vec.py: N-gram (4 context words) next-word prediction."""
+    DICT, EMB, H = 128, 16, 32
+    ws = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+          for i in range(4)]
+    nxt = fluid.layers.data(name="next", shape=[1], dtype="int64")
+    embs = [fluid.layers.embedding(
+        w, size=[DICT, EMB], param_attr={"name": "shared_emb"})
+        for w in ws]
+    concat = fluid.layers.concat(embs, axis=1)
+    hidden = fluid.layers.fc(input=concat, size=H, act="sigmoid")
+    logits = fluid.layers.fc(input=hidden, size=DICT)
+    cost = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, nxt))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    ctx = rng.randint(0, DICT, (512, 4)).astype(np.int64)
+    target = ((ctx.sum(1) * 7) % DICT).astype(np.int64).reshape(-1, 1)
+    losses = []
+    for _ in range(60):
+        feed = {f"w{i}": ctx[:, i:i+1] for i in range(4)}
+        feed["next"] = target
+        (l,) = exe.run(feed=feed, fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_recommender_system():
+    """test_recommender_system.py: user/movie twin towers → dot-product
+    rating regression on the movielens schema."""
+    ml = dataset.movielens
+    usr = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+    gender = fluid.layers.data(name="gender", shape=[1], dtype="int64")
+    age = fluid.layers.data(name="age", shape=[1], dtype="int64")
+    job = fluid.layers.data(name="job", shape=[1], dtype="int64")
+    mov = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+    rating = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+    def tower(feats, sizes):
+        embs = [fluid.layers.embedding(f, size=[v, 16])
+                for f, v in zip(feats, sizes)]
+        cat = fluid.layers.concat(embs, axis=1)
+        return fluid.layers.fc(input=cat, size=32, act="tanh")
+
+    usr_vec = tower([usr, gender, age, job],
+                    [ml.USER_COUNT, 2, ml.AGE_BANDS, ml.JOB_COUNT])
+    mov_vec = tower([mov], [ml.MOVIE_COUNT])
+    prod = fluid.layers.elementwise_mul(usr_vec, mov_vec)
+    pred = fluid.layers.fc(input=prod, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, rating))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    samples = list(rd.firstn(ml.train(), 512)())
+    feed_np = {
+        "user_id": np.asarray([[s[0]] for s in samples], np.int64),
+        "gender": np.asarray([[s[1]] for s in samples], np.int64),
+        "age": np.asarray([[s[2]] for s in samples], np.int64),
+        "job": np.asarray([[s[3]] for s in samples], np.int64),
+        "movie_id": np.asarray([[s[4]] for s in samples], np.int64),
+        "score": np.asarray([[s[7]] for s in samples], np.float32),
+    }
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(feed=feed_np, fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_image_classification_vgg_cifar():
+    """test_image_classification.py: VGG on cifar-shaped data; smoke-scale
+    (few steps, loss must drop and BN/dropout must behave)."""
+    img = fluid.layers.data(name="image", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    logits = vgg.vgg_cifar(img, class_dim=4)
+    cost = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.003).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    temps = rng.rand(4, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 4, 64)
+    xs = (temps[ys] + 0.05 * rng.rand(64, 3, 32, 32)).astype(np.float32)
+    ys = ys.reshape(-1, 1).astype(np.int64)
+    losses = []
+    for _ in range(8):
+        (l,) = exe.run(feed={"image": xs, "label": ys}, fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_label_semantic_roles_crf():
+    """test_label_semantic_roles.py essence: BiGRU emission + linear-chain
+    CRF loss + viterbi decoding; tags follow a learnable pattern."""
+    VOCAB, NTAG, H = 64, 5, 32
+    words = fluid.layers.sequence_data(name="words", shape=[1],
+                                       dtype="int64")
+    tags = fluid.layers.sequence_data(name="tags", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[VOCAB, 16])
+    proj = fluid.layers.sequence_fc(emb, size=3 * H)
+    gru = fluid.layers.dynamic_gru(proj, size=H)
+    emission = fluid.layers.sequence_fc(gru, size=NTAG)
+    nll = fluid.layers.linear_chain_crf(emission, tags)
+    cost = fluid.layers.mean(nll)
+    decoded = fluid.layers.crf_decoding(emission, nll._crf_transition)
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs, tag_seqs = [], []
+    for _ in range(128):
+        ln = rng.randint(3, 9)
+        toks = rng.randint(0, VOCAB, ln)
+        tg = toks % NTAG  # deterministic tag per token
+        seqs.append(toks.reshape(-1, 1).astype(np.int64))
+        tag_seqs.append(tg.reshape(-1, 1).astype(np.int64))
+    losses = []
+    for _ in range(25):
+        (l,) = exe.run(feed={"words": LoDTensor.from_sequences(seqs),
+                             "tags": LoDTensor.from_sequences(tag_seqs)},
+                       fetch_list=[cost])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.3, losses[::5]
+
+    # viterbi decode accuracy on the training set should be high
+    paths, = exe.run(feed={"words": LoDTensor.from_sequences(seqs),
+                           "tags": LoDTensor.from_sequences(tag_seqs)},
+                     fetch_list=[decoded])
+    correct = total = 0
+    for b, tg in enumerate(tag_seqs):
+        n = len(tg)
+        correct += int((paths[b, :n] == tg.ravel()).sum())
+        total += n
+    assert correct / total > 0.9, correct / total
+
+
+def test_deepfm_ctr():
+    """CTR DeepFM (BASELINE.json config 5): sparse field embeddings + FM +
+    deep tower; AUC-friendly separable synthetic clicks."""
+    NF, VOCAB = 6, 256
+    fields = fluid.layers.data(name="fields", shape=[NF], dtype="int64")
+    label = fluid.layers.data(name="click", shape=[1], dtype="float32")
+    logit = deepfm.deepfm(fields, num_fields=NF, vocab_size=VOCAB,
+                          embed_dim=8, hidden_sizes=(32, 16))
+    loss = fluid.layers.mean(
+        fluid.layers.elementwise_add(
+            fluid.layers.scale(logit, scale=0.0),  # keep graph tidy
+            _bce(logit, label)))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (512, NF)).astype(np.int64)
+    click = ((ids.sum(1) % 2)).astype(np.float32).reshape(-1, 1)
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(feed={"fields": ids, "click": click},
+                       fetch_list=[loss])
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.8, losses[::6]
+
+
+def _bce(logit, label):
+    helper_out = None
+    from paddle_tpu.framework.layer_helper import LayerHelper
+
+    helper = LayerHelper("bce")
+    out = helper.create_tmp_variable("float32")
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": [logit.name], "Label": [label.name]},
+        outputs={"Out": [out.name]})
+    return out
