@@ -277,6 +277,56 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 # --- losses / metrics -------------------------------------------------------
 
 
+def multi_head_attention(queries, keys, values, num_heads, causal=False,
+                         param_attr=None, name=None):
+    """Transformer multi-head attention over [B, T, D] (beyond-reference:
+    the 2018 reference's closest construct is v1 simple_attention).  QKV and
+    output projections are fc ops (MXU GEMMs); the core runs
+    scaled_dot_product_attention — ring attention when the executor's mesh
+    has an 'sp' axis."""
+    helper = LayerHelper("multi_head_attention", name=name)
+    D = queries.shape[-1]
+    assert D % num_heads == 0, "hidden size must divide num_heads"
+    q = fc(queries, D, num_flatten_dims=2, param_attr=param_attr,
+           bias_attr=False)
+    k = fc(keys, D, num_flatten_dims=2, param_attr=param_attr,
+           bias_attr=False)
+    v = fc(values, D, num_flatten_dims=2, param_attr=param_attr,
+           bias_attr=False)
+
+    def split_heads(x):
+        r = helper.create_tmp_variable(x.dtype)
+        helper.append_op("reshape", inputs={"X": [x.name]},
+                         outputs={"Out": [r.name]},
+                         attrs={"shape": [0, 0, num_heads, D // num_heads]})
+        t = helper.create_tmp_variable(x.dtype)
+        helper.append_op("transpose", inputs={"X": [r.name]},
+                         outputs={"Out": [t.name]},
+                         attrs={"axis": [0, 2, 1, 3]})
+        return t
+
+    qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+    attn = helper.create_tmp_variable(queries.dtype)
+    helper.append_op(
+        "scaled_dot_product_attention",
+        inputs={"Q": [qh.name], "K": [kh.name], "V": [vh.name]},
+        outputs={"Out": [attn.name]},
+        attrs={"causal": causal},
+    )
+    back = helper.create_tmp_variable(queries.dtype)
+    helper.append_op("transpose", inputs={"X": [attn.name]},
+                     outputs={"Out": [back.name]},
+                     attrs={"axis": [0, 2, 1, 3]})
+    merged = helper.create_tmp_variable(queries.dtype, shape=queries.shape)
+    helper.append_op("reshape", inputs={"X": [back.name]},
+                     outputs={"Out": [merged.name]},
+                     attrs={"shape": [0, 0, D]})
+    out = fc(merged, D, num_flatten_dims=2, bias_attr=False)
+    from .sequence import propagate_length
+
+    return propagate_length(queries, out)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     helper = LayerHelper("matmul", name=name)
     out = helper.create_tmp_variable(x.dtype)

@@ -91,6 +91,27 @@ def attention_gru_decoder(ctx, ins, attrs):
             "Context": [jnp.moveaxis(ctxs, 0, 1)]}
 
 
+@register_op("scaled_dot_product_attention")
+def scaled_dot_product_attention(ctx, ins, attrs):
+    """Multi-head attention core: Q,K,V [B,H,T,D] → [B,H,T,D].
+
+    Under a ParallelExecutor whose mesh has an 'sp' axis > 1, dispatches to
+    ring attention (parallel/ring_attention.py) — the sequence axis stays
+    sharded and K/V chunks rotate over ICI; otherwise dense flash-style
+    softmax (XLA fuses it)."""
+    from ..parallel import ring_attention as ra
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = bool(attrs.get("causal", False))
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is not None and "sp" in mesh.axis_names and (
+            dict(zip(mesh.axis_names, mesh.devices.shape))["sp"] > 1):
+        out = ra.ring_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+    else:
+        out = ra.attention(q, k, v, causal=causal)
+    return {"Out": [out]}
+
+
 @register_op("beam_search_generate", grad=None)
 def beam_search_generate(ctx, ins, attrs):
     """Beam-search decoding, fully on device.

@@ -1,0 +1,105 @@
+"""Ring attention: sequence/context parallelism over the 'sp' mesh axis.
+
+Beyond-reference capability (the 2018 reference predates attention — its
+long-sequence story was LoD ragged tensors, SURVEY.md §5; modern long-context
+needs the sequence axis *sharded*).  Implementation follows the ring-attention
+pattern (PAPERS.md / scaling-book): Q, K, V are sharded along the sequence
+axis across 'sp' devices; each device holds its Q chunk, and K/V chunks rotate
+around the ring via `lax.ppermute` (ICI neighbor exchange) while a streaming
+(flash-style) online softmax accumulates — max `m`, normalizer `l`, and
+output `o` — so the full [T,T] score matrix never materializes and memory per
+chip is O(T/S · D + (T/S)²).
+
+`ring_attention` is pure JAX (usable directly under pjit/shard_map);
+`attention` is the dense single-device reference the tests compare against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+
+def attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Dense reference: q,k,v [B, H, T, D] → [B, H, T, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Per-shard ring loop: local q [B,H,t,D]; k/v chunks rotate."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, H, t, D = q.shape
+    S = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+
+    qs = q * scale
+    # derive accumulators from q so they carry the same device-varying type
+    # as the rotating k/v (shard_map vma typing)
+    zero = (qs[..., 0] * 0.0).astype(jnp.float32)
+    m = zero - 1e30
+    l = zero
+    o = (qs * 0.0).astype(jnp.float32)
+
+    def step(carry, s):
+        m, l, o, k_cur, v_cur = carry
+        # ppermute sends i -> i+1, so after s hops we hold chunk (my - s)
+        src_chunk = (my - s) % S
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qs, k_cur).astype(jnp.float32)
+        if causal:
+            q_pos = my * t + jnp.arange(t)
+            k_pos = src_chunk * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * correction + p.sum(axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate k/v to the next device on the ring (ICI neighbor hop)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, o_new, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m, l, o, k, v), jnp.arange(S))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """q,k,v [B,H,T,D] (T divisible by mesh['sp']) → [B,H,T,D], computed with
+    the sequence axis sharded over `axis_name`."""
+    import jax
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                          scale=s),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
