@@ -1,0 +1,8 @@
+"""Hand-written Pallas TPU kernels for hot ops.
+
+The reference hand-wrote CUDA for its hot paths (paddle/cuda hl_* kernels,
+fused LSTM/GRU cells — SURVEY.md §2.10); XLA generates most of that here, and
+Pallas covers the remaining custom fusions. Kernels run `interpret=True`
+off-TPU so tests validate the same code path the chip runs."""
+
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,100 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Single-chip fused attention: never materializes the [T,T] score matrix in
+HBM. Grid over (batch*heads, Tq/BQ); each program streams K/V blocks from
+VMEM with an online-softmax accumulator (running max m, normalizer l) —
+the same recurrence ring_attention uses across chips, here across blocks
+inside one chip. MXU does the two GEMMs per block; VPU the rescaling.
+
+Replaces what the reference would have hand-written in paddle/cuda
+(SURVEY.md §2.10): the custom-fusion tier under the XLA-generated ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, bk: int, scale: float,
+            causal: bool, bq: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D] in input dtype — keep bf16 for full-rate MXU
+    T = k_ref.shape[1]
+    D = q.shape[-1]
+    nblk = T // bk
+
+    m0 = jnp.full((q.shape[0],), -1e30, dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
+    o0 = jnp.zeros((q.shape[0], D), dtype=jnp.float32)
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :]  # [BK, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        # bf16 GEMM, f32 accumulate (full-rate MXU), then scale in f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * corr[:, None] + pv
+        return m_new, l_new, o_new
+
+    if causal:
+        # skip fully-masked K blocks beyond the diagonal
+        last = (qi + 1) * bq  # first k index NOT attendable is >= last
+        nblk_eff = (last + bk - 1) // bk
+    else:
+        nblk_eff = nblk
+    m, l, o = jax.lax.fori_loop(0, nblk_eff, body, (m0, l0, o0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """q,k,v [B,H,T,D] → [B,H,T,D]. T must divide block_q/block_k
+    (pad+mask upstream otherwise); D ≤ 128 recommended (one lane tile)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    assert T % bq == 0 and T % bk == 0, (T, bq, bk)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+
+    grid = (B * H, T // bq)
+    out = pl.pallas_call(
+        functools.partial(_kernel, bk=bk, scale=s, causal=causal, bq=bq),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)

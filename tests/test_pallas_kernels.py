@@ -1,0 +1,27 @@
+"""Pallas kernel tests in interpret mode (same code path as the chip)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_kernels.flash_attention import flash_attention
+from paddle_tpu.parallel.ring_attention import attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_dense(causal):
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 3, 64, 32
+    q = rng.randn(B, H, T, D).astype(np.float32)
+    k = rng.randn(B, H, T, D).astype(np.float32)
+    v = rng.randn(B, H, T, D).astype(np.float32)
+    dense = attention(q, k, v, causal=causal)
+    flash = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                            interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_block_not_dividing_raises():
+    q = np.zeros((1, 1, 60, 16), np.float32)
+    with pytest.raises(AssertionError):
+        flash_attention(q, q, q, block_q=16, block_k=16, interpret=True)
