@@ -21,15 +21,41 @@ import numpy as np
 BASELINE_IMG_S = 81.69  # reference ResNet-50 bs64 train (IntelOptimizedPaddle.md:45)
 
 
+def _build_lstm_bench(batch_size, hidden, seq_len, dtype):
+    """Reference RNN baseline shape (benchmark/README.md:119): stacked
+    2xLSTM+fc text classification, bs64 h512 seqlen100 → 184 ms/batch on
+    K40m."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import image_models
+
+    words = fluid.layers.sequence_data(name="words", shape=[1],
+                                       dtype="int64", max_len=seq_len)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[30000, hidden],
+                                          dtype=dtype)
+    logits = image_models.stacked_lstm_net(emb, hidden_dim=hidden,
+                                           stacked_num=2, class_dim=2)
+    logits32 = fluid.layers.cast(logits, "float32") \
+        if dtype != "float32" else logits
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits32, label))
+    fluid.optimizer.Adam(learning_rate=0.002).minimize(loss)
+    return loss
+
+
 def main():
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
+    model = os.environ.get("BENCH_MODEL", "resnet")
     batch_size = int(os.environ.get("BENCH_BS", "64"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    if model == "lstm":
+        return _bench_lstm(batch_size, dtype, warmup, iters)
 
     avg_cost, acc = resnet.build_train_program(
         batch_size=batch_size, depth=depth, dtype=dtype)
@@ -68,6 +94,48 @@ def main():
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+def _bench_lstm(batch_size, dtype, warmup, iters):
+    """ms/batch for the reference's stacked-LSTM benchmark (K40m h512 bs64:
+    184 ms/batch, benchmark/README.md:119)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+
+    BASELINE_MS = 184.0
+    hidden = int(os.environ.get("BENCH_HIDDEN", "512"))
+    seq_len = int(os.environ.get("BENCH_SEQLEN", "96"))
+
+    loss = _build_lstm_bench(batch_size, hidden, seq_len, dtype)
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    feed = {
+        "words": jax.device_put(jnp.asarray(
+            rng.randint(0, 30000, (batch_size, seq_len, 1))), dev),
+        "words@LENGTH": jax.device_put(jnp.full(
+            (batch_size,), seq_len, dtype=jnp.int32), dev),
+        "label": jax.device_put(jnp.asarray(
+            rng.randint(0, 2, (batch_size, 1))), dev),
+    }
+    for _ in range(warmup):
+        (l,) = exe.run(feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        (l,) = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(l)
+    dt = (time.perf_counter() - t0) / iters
+    ms = dt * 1e3
+    print(json.dumps({
+        "metric": f"lstm2x_h{hidden}_seq{seq_len}_train_ms_per_batch_bs{batch_size}",
+        "value": round(ms, 2),
+        "unit": "ms/batch",
+        "vs_baseline": round(BASELINE_MS / ms, 2),
     }))
 
 

@@ -3,6 +3,7 @@ models (benchmark/paddle/image/{resnet,vgg,alexnet,googlenet}.py and
 fluid/tests/book/)."""
 
 from . import deepfm  # noqa: F401
+from . import image_models  # noqa: F401
 from . import resnet  # noqa: F401
 from . import seq2seq  # noqa: F401
 from . import vgg  # noqa: F401

@@ -1,0 +1,196 @@
+"""CTC ops: warpctc loss, ctc_align, edit_distance (reference
+operators/warpctc_op.cc — dyn-loaded warp-ctc, ctc_align_op.cc,
+edit_distance_op.cc; legacy gserver CTCLayer + CTCErrorEvaluator).
+
+The reference links Baidu's warp-ctc CUDA library; here CTC is the standard
+log-space forward algorithm over the blank-interleaved label sequence as one
+lax.scan — differentiable by construction (no hand-written CTC backward),
+MXU-free but VPU-parallel over the batch."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+NEG_INF = -1e30
+
+
+@register_op("warpctc", non_diff_inputs=("Label", "LogitsLength",
+                                         "LabelLength"))
+def warpctc(ctx, ins, attrs):
+    """Inputs: Logits [B,T,C] (unnormalized), Label [B,L] int (padded),
+    LogitsLength [B], LabelLength [B]. attrs: blank (default 0).
+    Output: Loss [B,1] = -log p(label | logits) per sequence."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0]
+    if logits.dtype not in (jnp.float32, jnp.float64):
+        logits = logits.astype(jnp.float32)
+    labels = ins["Label"][0].astype(jnp.int32)
+    logit_lens = ins["LogitsLength"][0]
+    label_lens = ins["LabelLength"][0]
+    blank = int(attrs.get("blank", 0))
+
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1  # blank-interleaved length
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lens[:, None] + 1)
+
+    # can we skip from s-2 to s? only if ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, dtype=jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext, axis=1)  # [B,S]
+
+    alpha0 = jnp.full((B, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lens > 0, first_lab,
+                                           NEG_INF))
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new = merged + emit(t)
+        new = jnp.where(ext_valid, new, NEG_INF)
+        # frames past a sequence's logit length freeze alpha
+        alive = (t < logit_lens)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alpha_T, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # total prob = alpha[2*label_len] + alpha[2*label_len - 1]
+    end_idx = 2 * label_lens
+    a_end = jnp.take_along_axis(alpha_T, end_idx[:, None], axis=1)[:, 0]
+    a_end1 = jnp.take_along_axis(
+        alpha_T, jnp.maximum(end_idx - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(a_end, jnp.where(label_lens > 0, a_end1, NEG_INF))
+    return {"Loss": [(-ll)[:, None]]}
+
+
+@register_op("ctc_align", grad=None)
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode post-processing (ctc_align_op.cc): collapse repeats
+    then drop blanks, under static shapes: Output [B,T] left-packed with
+    OutputLength [B]."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = ins["Input"][0].astype(jnp.int32)  # [B,T] argmax token ids
+    lengths = ins["Length"][0]
+    blank = int(attrs.get("blank", 0))
+    B, T = ids.shape
+    prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, dtype=jnp.int32), ids[:, :-1]], axis=1)
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])
+    keep = (ids != blank) & (ids != prev) & valid
+    # left-pack kept tokens: position = cumsum(keep) - 1
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.zeros((B, T), dtype=jnp.int32)
+    b_idx = jnp.repeat(jnp.arange(B)[:, None], T, axis=1)
+    out = out.at[b_idx, jnp.where(keep, pos, T - 1)].set(
+        jnp.where(keep, ids, 0), mode="drop")
+    # note: mode='drop' ignores writes at T-1 from masked slots colliding;
+    # rewrite masked target to a scratch column then zero it
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    # ensure slots >= out_len are zero
+    out = jnp.where(jnp.arange(T)[None, :] < out_len[:, None], out, 0)
+    return {"Output": [out], "OutputLength": [out_len]}
+
+
+@register_op("edit_distance", grad=None)
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per pair (edit_distance_op.cc): Hyps [B,Lh],
+    Refs [B,Lr] + lengths; attr normalized divides by ref length."""
+    import jax
+    import jax.numpy as jnp
+
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    hyp_len = ins["HypsLength"][0]
+    ref_len = ins["RefsLength"][0]
+    B, Lh = hyp.shape
+    Lr = ref.shape[1]
+
+    # DP over hyp positions; row = distances against ref prefix [B, Lr+1]
+    row0 = jnp.broadcast_to(jnp.arange(Lr + 1, dtype=jnp.float32)[None, :],
+                            (B, Lr + 1))
+    # positions beyond ref_len clamp to the value at ref_len later
+
+    def step(row, i):
+        # cost of aligning hyp[:, i]
+        sub_or_match = (ref != hyp[:, i][:, None]).astype(jnp.float32)
+        del_cost = row[:, :-1] + sub_or_match  # diagonal
+        ins_cost = row[:, 1:] + 1.0  # up (delete from hyp)
+        new_rest = jnp.minimum(del_cost, ins_cost)
+
+        first = row[:, 0] + 1.0
+
+        def scan_min(carry, j):
+            left = carry
+            val = jnp.minimum(new_rest[:, j], left + 1.0)
+            return val, val
+
+        _, cols = jax.lax.scan(scan_min, first, jnp.arange(Lr))
+        new_row = jnp.concatenate([first[:, None], cols.T], axis=1)
+        alive = (i < hyp_len)[:, None]
+        return jnp.where(alive, new_row, row), None
+
+    row_final, _ = jax.lax.scan(step, row0, jnp.arange(Lh))
+    dist = jnp.take_along_axis(row_final, ref_len[:, None], axis=1)[:, 0]
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return {"Out": [dist[:, None]],
+            "SequenceNum": [jnp.asarray([B], dtype=jnp.int64)]}
+
+
+@register_op("nce", non_diff_inputs=("Label",))
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation loss (nce_op.cc): Input [B,D], Weight
+    [C,D], Bias [C], Label [B,1]; attrs num_neg_samples. Samples negatives
+    uniformly with the executor's per-op PRNG."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    k = int(attrs.get("num_neg_samples", 10))
+    C = w.shape[0]
+    B = x.shape[0]
+    key = ctx.rng(attrs)
+    neg = jax.random.randint(key, (B, k), 0, C)
+
+    def logit(idx):
+        wi = w[idx]  # [..., D]
+        out = jnp.sum(wi * x[:, None, :] if wi.ndim == 3 else wi * x,
+                      axis=-1)
+        if b is not None:
+            out = out + b[idx]
+        return out
+
+    pos_logit = logit(label)  # [B]
+    neg_logit = logit(neg)  # [B,k]
+    # uniform noise: log q = -log C
+    log_q = -jnp.log(float(C))
+    pos = jax.nn.log_sigmoid(pos_logit - log_q)
+    negs = jax.nn.log_sigmoid(-(neg_logit - log_q)).sum(axis=1)
+    cost = -(pos + negs)
+    return {"Cost": [cost[:, None]],
+            "SampleLogits": [neg_logit],
+            "SampleLabels": [neg]}

@@ -102,6 +102,7 @@ class OpTestHarness:
         grad_map = {p.name: g.name for p, g in params_grads}
 
         exe = fluid.Executor(fluid.CPUPlace())
+        exe._step = 0  # pin the RNG step: stochastic ops (dropout, nce)
         scope = fluid.global_scope()
         self._scope_feed(scope)
 
@@ -121,6 +122,7 @@ class OpTestHarness:
         fscope = fluid.global_scope()
 
         def forward(overrides):
+            fexe._step = 0  # same RNG key every perturbation
             self._scope_feed(fscope, overrides)
             (v,) = fexe.run(fprog, feed={}, fetch_list=[floss])
             return float(v.item())

@@ -1,0 +1,116 @@
+"""CTC / edit-distance / NCE op tests against brute-force references."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+
+def _brute_ctc(logp, label, blank=0):
+    """Sum over all alignments by enumeration (tiny T)."""
+    T, C = logp.shape
+    paths = itertools.product(range(C), repeat=T)
+    total = -np.inf
+    for p in paths:
+        # collapse
+        out = []
+        prev = -1
+        for c in p:
+            if c != prev and c != blank:
+                out.append(c)
+            prev = c
+        if out == list(label):
+            score = sum(logp[t, p[t]] for t in range(T))
+            total = np.logaddexp(total, score)
+    return -total
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, C = 5, 4
+    logits = rng.randn(2, T, C).astype(np.float64)
+    logp = logits - np.log(
+        np.exp(logits).sum(-1, keepdims=True))
+    labels = np.asarray([[1, 2], [3, 0]], dtype=np.int64)  # second len=1
+    t = OpTestHarness(
+        "warpctc",
+        {"Logits": logits, "Label": labels,
+         "LogitsLength": np.asarray([T, T], np.int32),
+         "LabelLength": np.asarray([2, 1], np.int32)},
+        {"blank": 0},
+        out_slots=["Loss"])
+    want0 = _brute_ctc(logp[0], [1, 2])
+    want1 = _brute_ctc(logp[1], [3])
+    t.check_output({"Loss": np.asarray([[want0], [want1]])}, atol=1e-6)
+
+
+def test_warpctc_grad():
+    rng = np.random.RandomState(1)
+    T, C = 4, 3
+    logits = rng.randn(2, T, C) * 0.5
+    labels = np.asarray([[1, 2], [2, 1]], dtype=np.int64)
+    t = OpTestHarness(
+        "warpctc",
+        {"Logits": logits, "Label": labels,
+         "LogitsLength": np.asarray([T, T], np.int32),
+         "LabelLength": np.asarray([2, 2], np.int32)},
+        {"blank": 0},
+        out_slots=["Loss"])
+    t.check_grad(["Logits"], output_slot="Loss", max_relative_error=1e-2)
+
+
+def test_ctc_align():
+    ids = np.asarray([[0, 1, 1, 0, 2, 2, 3],
+                      [1, 0, 1, 1, 0, 0, 0]], dtype=np.int64)
+    lens = np.asarray([7, 5], np.int32)
+    t = OpTestHarness("ctc_align", {"Input": ids, "Length": lens},
+                      {"blank": 0}, out_slots=["Output", "OutputLength"])
+    got = t.check_output({
+        "Output": np.asarray([[1, 2, 3, 0, 0, 0, 0],
+                              [1, 1, 0, 0, 0, 0, 0]]),
+        "OutputLength": np.asarray([3, 2]),
+    })
+
+
+def test_edit_distance():
+    # kitten -> sitting = 3
+    hyp = np.asarray([[1, 2, 3, 3, 4, 5, 0]], dtype=np.int64)  # kitten
+    ref = np.asarray([[6, 2, 3, 3, 2, 5, 7]], dtype=np.int64)  # sitting
+    t = OpTestHarness(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref,
+         "HypsLength": np.asarray([6], np.int32),
+         "RefsLength": np.asarray([7], np.int32)},
+        {"normalized": False},
+        out_slots=["Out", "SequenceNum"])
+    t.check_output({"Out": np.asarray([[3.0]])})
+
+
+def test_edit_distance_identical_and_empty():
+    hyp = np.asarray([[1, 2, 3], [1, 2, 3]], dtype=np.int64)
+    ref = np.asarray([[1, 2, 3], [4, 5, 0]], dtype=np.int64)
+    t = OpTestHarness(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref,
+         "HypsLength": np.asarray([3, 3], np.int32),
+         "RefsLength": np.asarray([3, 2], np.int32)},
+        {"normalized": False},
+        out_slots=["Out", "SequenceNum"])
+    t.check_output({"Out": np.asarray([[0.0], [3.0]])})
+
+
+def test_nce_runs_and_differentiates():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8) * 0.3
+    w = rng.randn(16, 8) * 0.3
+    b = rng.randn(16) * 0.1
+    label = rng.randint(0, 16, (4, 1)).astype(np.int64)
+    t = OpTestHarness(
+        "nce",
+        {"Input": x, "Weight": w, "Bias": b, "Label": label},
+        {"num_neg_samples": 5},
+        out_slots=["Cost", "SampleLogits", "SampleLabels"])
+    t.check_grad(["Input", "Weight"], output_slot="Cost",
+                 max_relative_error=1e-2)

@@ -1,0 +1,30 @@
+"""Benchmark model zoo construction + forward smoke tests."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import image_models
+
+
+def _forward(build, shape, class_dim):
+    img = fluid.layers.data(name="image", shape=list(shape), dtype="float32")
+    logits = build(img, class_dim)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(0).rand(2, *shape).astype(np.float32)
+    infer = fluid.default_main_program().clone(for_test=True)
+    (out,) = exe.run(infer, feed={"image": x}, fetch_list=[logits])
+    assert out.shape == (2, class_dim)
+    assert np.isfinite(out).all()
+
+
+def test_alexnet_forward():
+    _forward(image_models.alexnet, (3, 227, 227), 100)
+
+
+def test_googlenet_forward():
+    _forward(image_models.googlenet, (3, 224, 224), 100)
+
+
+def test_smallnet_forward():
+    _forward(image_models.smallnet_mnist_cifar, (3, 32, 32), 10)
