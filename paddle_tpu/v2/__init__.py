@@ -1,0 +1,26 @@
+"""v2-style API (reference python/paddle/v2/__init__.py): the
+reader-driven SGD.train event loop, Parameters with tar serialization,
+batching, datasets — over the fluid-style layer graph.
+
+    import paddle_tpu.v2 as paddle
+    cost = ...  # build with paddle_tpu.layers
+    trainer = paddle.trainer.SGD(cost=cost,
+                                 update_equation=paddle.optimizer.Adam(...))
+    trainer.train(paddle.batch(paddle.dataset.mnist.train(), 64),
+                  num_passes=2, event_handler=handler)
+"""
+
+from .. import dataset  # noqa: F401
+from .. import optimizer  # noqa: F401
+from .. import reader  # noqa: F401
+from ..reader import batch  # noqa: F401
+from . import event  # noqa: F401
+from . import trainer  # noqa: F401
+from .parameters import Parameters  # noqa: F401
+from .trainer import SGD, infer  # noqa: F401
+
+
+def init(use_gpu=False, trainer_count=1, **kw):
+    """Process init (reference paddle.init → swig init): devices come from
+    JAX; kept for API parity."""
+    return None

@@ -1,0 +1,64 @@
+"""v2 Parameters: numpy get/set + tar serialization (reference
+python/paddle/v2/parameters.py — to_tar/from_tar)."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from ..framework.core import Parameter, default_main_program
+from ..framework.scope import global_scope
+
+
+class Parameters:
+    def __init__(self, program=None, scope=None):
+        self.program = program or default_main_program()
+        self.scope = scope or global_scope()
+
+    def names(self):
+        return [p.name for p in
+                self.program.global_block().all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def get(self, name) -> np.ndarray:
+        v = self.scope.find(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+    def set(self, name, value):
+        import jax.numpy as jnp
+
+        self.scope.set(name, jnp.asarray(value))
+
+    __getitem__ = get
+    __setitem__ = set
+
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                arr = self.get(name)
+                buf = io.BytesIO()
+                np.save(buf, arr, allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    def from_tar(self, f):
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                name = member.name[:-4]
+                # np.load wants a real file handle; buffer the member
+                data = io.BytesIO(tar.extractfile(member).read())
+                self.set(name, np.load(data))
+        return self
+
+    @staticmethod
+    def from_tar_new(f, program=None):
+        p = Parameters(program)
+        return p.from_tar(f)

@@ -1,0 +1,77 @@
+"""v2 API tests: the SGD.train event loop over readers, Parameters tar
+round-trip, test()/infer() (reference v2 trainer/parameters tests)."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+
+
+def _housing_cost():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return cost, pred
+
+
+def test_v2_train_event_loop():
+    cost, _ = _housing_cost()
+    trainer = paddle.trainer.SGD(
+        cost=cost, update_equation=paddle.optimizer.SGD(learning_rate=0.05))
+
+    events = {"begin_pass": 0, "end_pass": 0, "iters": 0, "costs": []}
+
+    def handler(e):
+        if isinstance(e, paddle.event.BeginPass):
+            events["begin_pass"] += 1
+        elif isinstance(e, paddle.event.EndPass):
+            events["end_pass"] += 1
+        elif isinstance(e, paddle.event.EndIteration):
+            events["iters"] += 1
+            events["costs"].append(e.cost)
+
+    reader = paddle.batch(paddle.dataset.uci_housing.train(), 64)
+    trainer.train(reader, num_passes=10, event_handler=handler,
+                  feeding={"x": 0, "y": 1})
+    assert events["begin_pass"] == events["end_pass"] == 10
+    assert events["iters"] == 10 * len(list(reader()))
+    assert events["costs"][-1] < events["costs"][0]
+
+    res = trainer.test(paddle.batch(paddle.dataset.uci_housing.test(), 64),
+                       feeding={"x": 0, "y": 1})
+    assert np.isfinite(res.cost)
+
+
+def test_v2_parameters_tar_roundtrip():
+    cost, pred = _housing_cost()
+    trainer = paddle.trainer.SGD(
+        cost=cost, update_equation=paddle.optimizer.SGD(learning_rate=0.05))
+    reader = paddle.batch(paddle.dataset.uci_housing.train(), 64)
+    trainer.train(reader, num_passes=3, feeding={"x": 0, "y": 1})
+
+    params = trainer.parameters
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    w_before = params.get(params.names()[0]).copy()
+
+    # clobber then restore
+    params.set(params.names()[0], np.zeros_like(w_before))
+    buf.seek(0)
+    params.from_tar(buf)
+    np.testing.assert_allclose(params.get(params.names()[0]), w_before)
+
+
+def test_v2_infer():
+    cost, pred = _housing_cost()
+    trainer = paddle.trainer.SGD(
+        cost=cost, update_equation=paddle.optimizer.SGD(learning_rate=0.05))
+    reader = paddle.batch(paddle.dataset.uci_housing.train(), 64)
+    trainer.train(reader, num_passes=5, feeding={"x": 0, "y": 1})
+    samples = [(x,) for x, _ in list(paddle.dataset.uci_housing.test()())[:8]]
+    out = paddle.infer(output_layer=pred, parameters=trainer.parameters,
+                       input=samples)
+    assert out.shape == (8, 1)
+    assert np.isfinite(out).all()
