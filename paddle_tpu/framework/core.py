@@ -402,6 +402,18 @@ class Program:
         return p
 
     # -- serialization ------------------------------------------------------
+    def to_proto(self) -> bytes:
+        """Serialized ProgramDef wire bytes (framework.proto)."""
+        from . import proto_io
+
+        return proto_io.serialize_program(self)
+
+    @staticmethod
+    def from_proto(data: bytes) -> "Program":
+        from . import proto_io
+
+        return proto_io.parse_program(data)
+
     def to_json(self) -> str:
         return json.dumps(
             {

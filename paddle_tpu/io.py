@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 from typing import List, Optional
 
 import numpy as np
@@ -107,8 +108,33 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_var_names": list(feeded_var_names),
         "fetch_var_names": target_names,
     }
+    # `__model__`: the durable protobuf interchange form (reference model
+    # format doc/design/model_format.md), checked by the native validator.
+    # Serialize + validate BEFORE touching the output dir so a rejected or
+    # unserializable program never leaves a half-written model behind; fall
+    # back to JSON-only when the protoc toolchain is absent.
+    model_bytes = None
+    try:
+        from .framework import proto_io
+
+        model_bytes = proto_io.serialize_program(inference_program)
+    except (OSError, subprocess.SubprocessError, ImportError):
+        pass
+    if model_bytes is not None:
+        from .native import program_desc as _npd
+
+        # Only validate against an already-built library — a model save
+        # should not trigger a C++ compile as a side effect.
+        if os.path.exists(_npd._LIB):
+            ok, diag = _npd.validate(model_bytes)
+            if not ok:
+                raise ValueError(
+                    f"inference program failed validation:\n{diag}")
     with open(os.path.join(dirname, "program.json"), "w") as f:
         f.write(inference_program.to_json())
+    if model_bytes is not None:
+        with open(os.path.join(dirname, "__model__"), "wb") as f:
+            f.write(model_bytes)
     with open(os.path.join(dirname, "meta.json"), "w") as f:
         json.dump(meta, f)
     scope = scope or global_scope()
@@ -125,8 +151,20 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, scope=None):
     """io.py:301 equivalent → (program, feed_names, fetch_names)."""
-    with open(os.path.join(dirname, "program.json")) as f:
-        program = Program.from_json(f.read())
+    model_path = os.path.join(dirname, "__model__")
+    if os.path.exists(model_path):
+        from .framework import proto_io
+
+        with open(model_path, "rb") as f:
+            data = f.read()
+        program = proto_io.parse_program(data)
+        if not any(b.ops for b in program.blocks):
+            raise ValueError(
+                f"{model_path} holds an empty program "
+                f"({len(data)} bytes) — truncated save?")
+    else:
+        with open(os.path.join(dirname, "program.json")) as f:
+            program = Program.from_json(f.read())
     with open(os.path.join(dirname, "meta.json")) as f:
         meta = json.load(f)
     load_persistables(executor, dirname, scope=scope)

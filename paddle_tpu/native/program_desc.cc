@@ -1,0 +1,234 @@
+// Native Program IR library: parse / validate / prune / stats over the
+// serialized ProgramDef wire format (framework/framework.proto).
+//
+// TPU-native counterpart of the reference's C++ desc + prune layer
+// (reference: paddle/framework/program_desc.cc, block_desc.cc, prune.cc) —
+// the host-side graph tooling stays native so deployment tools (the
+// `paddle` CLI, the C inference API) can manipulate programs without a
+// Python interpreter.  Exposed as a C ABI consumed via ctypes
+// (native/program_desc.py).
+//
+// Build: protoc --cpp_out → framework.pb.cc, then
+//   g++ -O2 -shared -fPIC program_desc.cc framework.pb.cc -lprotobuf
+
+#include <cstdint>
+#include <cstring>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "framework.pb.h"
+
+using paddle_tpu::framework::AttrValue;
+using paddle_tpu::framework::BlockDef;
+using paddle_tpu::framework::OpDef;
+using paddle_tpu::framework::ProgramDef;
+using paddle_tpu::framework::VarDef;
+
+namespace {
+
+char* dup_bytes(const std::string& s, uint64_t* out_len) {
+  char* p = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(p, s.data(), s.size());
+  p[s.size()] = '\0';
+  if (out_len) *out_len = s.size();
+  return p;
+}
+
+// Does `name` resolve in block `idx` or any ancestor block?
+bool resolves(const ProgramDef& prog, int idx, const std::string& name) {
+  while (idx >= 0 && idx < prog.blocks_size()) {
+    const BlockDef& b = prog.blocks(idx);
+    for (const VarDef& v : b.vars())
+      if (v.name() == name) return true;
+    idx = b.parent_idx();
+  }
+  return false;
+}
+
+int sub_block_attr(const OpDef& op) {
+  for (const AttrValue& a : op.attrs())
+    if (a.kind() == AttrValue::BLOCK) return a.block_idx();
+  return -1;
+}
+
+// Backward-reachability prune of one block: keep ops any of whose outputs
+// are in `needed`; their inputs become needed.  Mirrors the semantics of
+// the reference's prune pass (framework/prune.cc) on the target block.
+void prune_block(ProgramDef* prog, int block_idx,
+                 std::set<std::string>* needed) {
+  BlockDef* block = prog->mutable_blocks(block_idx);
+  std::vector<OpDef> kept;
+  for (int i = block->ops_size() - 1; i >= 0; --i) {
+    const OpDef& op = block->ops(i);
+    bool want = false;
+    for (const auto& slot : op.outputs())
+      for (const auto& arg : slot.arguments())
+        if (needed->count(arg)) want = true;
+    if (!want) continue;
+    for (const auto& slot : op.inputs())
+      for (const auto& arg : slot.arguments())
+        if (!arg.empty()) needed->insert(arg);
+    kept.push_back(op);
+  }
+  block->clear_ops();
+  for (auto it = kept.rbegin(); it != kept.rend(); ++it)
+    *block->add_ops() = *it;
+}
+
+// Blocks referenced (transitively) from block 0 after pruning.
+void live_blocks(const ProgramDef& prog, int idx, std::set<int>* live) {
+  if (!live->insert(idx).second) return;
+  for (const OpDef& op : prog.blocks(idx).ops()) {
+    int sub = sub_block_attr(op);
+    if (sub >= 0 && sub < prog.blocks_size()) live_blocks(prog, sub, live);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_desc_free(char* p) { free(p); }
+
+// Structural validation.  Returns 0 and *diag=NULL when clean; otherwise 1
+// and *diag = malloc'd newline-separated diagnostics.
+int pt_desc_validate(const uint8_t* buf, uint64_t len, char** diag) {
+  ProgramDef prog;
+  if (!prog.ParseFromArray(buf, static_cast<int>(len))) {
+    *diag = dup_bytes("parse error: bad ProgramDef bytes", nullptr);
+    return 1;
+  }
+  std::ostringstream out;
+  if (prog.blocks_size() == 0) out << "program has no blocks\n";
+  for (int bi = 0; bi < prog.blocks_size(); ++bi) {
+    const BlockDef& b = prog.blocks(bi);
+    if (b.idx() != bi)
+      out << "block " << bi << ": idx field says " << b.idx() << "\n";
+    if (b.parent_idx() >= prog.blocks_size())
+      out << "block " << bi << ": parent " << b.parent_idx()
+          << " out of range\n";
+    // Vars defined so far in this block walk — ops may only read vars
+    // already produced, declared persistable/data, or visible in a parent.
+    std::set<std::string> produced;
+    for (const VarDef& v : b.vars())
+      if (v.persistable() || v.is_data()) produced.insert(v.name());
+    for (int oi = 0; oi < b.ops_size(); ++oi) {
+      const OpDef& op = b.ops(oi);
+      int sub = sub_block_attr(op);
+      if (sub >= prog.blocks_size())
+        out << "block " << bi << " op " << oi << " (" << op.type()
+            << "): sub_block " << sub << " out of range\n";
+      for (const auto& slot : op.inputs())
+        for (const auto& arg : slot.arguments()) {
+          if (arg.empty()) continue;
+          if (produced.count(arg)) continue;
+          if (!resolves(prog, bi, arg))
+            out << "block " << bi << " op " << oi << " (" << op.type()
+                << "): input '" << arg << "' is undeclared\n";
+          // Declared but not yet produced is legal for feeds and
+          // loop-carried vars; only undeclared names are hard errors.
+        }
+      for (const auto& slot : op.outputs())
+        for (const auto& arg : slot.arguments()) {
+          if (arg.empty()) continue;
+          if (!resolves(prog, bi, arg))
+            out << "block " << bi << " op " << oi << " (" << op.type()
+                << "): output '" << arg << "' is undeclared\n";
+          produced.insert(arg);
+        }
+    }
+  }
+  std::string msg = out.str();
+  if (msg.empty()) {
+    *diag = nullptr;
+    return 0;
+  }
+  *diag = dup_bytes(msg, nullptr);
+  return 1;
+}
+
+// Prune the program to the ops needed for `targets` (newline-separated).
+// Unreferenced nested blocks are dropped and block indices compacted.
+// On success returns 0 and *out/*out_len hold the new serialized bytes.
+int pt_desc_prune(const uint8_t* buf, uint64_t len, const char* targets,
+                  char** out, uint64_t* out_len) {
+  ProgramDef prog;
+  if (!prog.ParseFromArray(buf, static_cast<int>(len))) return 1;
+  if (prog.blocks_size() == 0) return 1;
+
+  std::set<std::string> needed;
+  std::istringstream ts(targets ? targets : "");
+  std::string line;
+  while (std::getline(ts, line))
+    if (!line.empty()) needed.insert(line);
+
+  prune_block(&prog, 0, &needed);
+
+  // Keep sub-blocks of surviving control-flow ops intact (their interior
+  // dataflow is opaque to block-0 reachability).
+  std::set<int> live;
+  live_blocks(prog, 0, &live);
+
+  ProgramDef pruned;
+  pruned.set_version(prog.version());
+  pruned.set_random_seed(prog.random_seed());
+  std::vector<int> remap(prog.blocks_size(), -1);
+  int next = 0;
+  for (int bi = 0; bi < prog.blocks_size(); ++bi)
+    if (live.count(bi)) remap[bi] = next++;
+  for (int bi = 0; bi < prog.blocks_size(); ++bi) {
+    if (remap[bi] < 0) continue;
+    BlockDef* nb = pruned.add_blocks();
+    *nb = prog.blocks(bi);
+    nb->set_idx(remap[bi]);
+    int parent = nb->parent_idx();
+    nb->set_parent_idx(parent >= 0 && remap[parent] >= 0 ? remap[parent]
+                                                         : -1);
+    for (OpDef& op : *nb->mutable_ops())
+      for (AttrValue& a : *op.mutable_attrs())
+        if (a.kind() == AttrValue::BLOCK) {
+          int b = a.block_idx();
+          a.set_block_idx(
+              b >= 0 && b < static_cast<int>(remap.size()) ? remap[b] : -1);
+        }
+  }
+
+  std::string bytes;
+  pruned.SerializeToString(&bytes);
+  *out = dup_bytes(bytes, out_len);
+  return 0;
+}
+
+// JSON stats line: {"blocks":N,"ops":N,"vars":N,"params":N,"op_types":N}.
+int pt_desc_stats(const uint8_t* buf, uint64_t len, char** out) {
+  ProgramDef prog;
+  if (!prog.ParseFromArray(buf, static_cast<int>(len))) return 1;
+  int ops = 0, vars = 0, params = 0;
+  std::set<std::string> types;
+  for (const BlockDef& b : prog.blocks()) {
+    ops += b.ops_size();
+    vars += b.vars_size();
+    for (const VarDef& v : b.vars())
+      if (v.is_parameter()) ++params;
+    for (const OpDef& op : b.ops()) types.insert(op.type());
+  }
+  std::ostringstream js;
+  js << "{\"blocks\":" << prog.blocks_size() << ",\"ops\":" << ops
+     << ",\"vars\":" << vars << ",\"params\":" << params
+     << ",\"op_types\":" << types.size() << "}";
+  *out = dup_bytes(js.str(), nullptr);
+  return 0;
+}
+
+// Human-readable dump (DebugString) for `paddle dump_config`.
+int pt_desc_text(const uint8_t* buf, uint64_t len, char** out,
+                 uint64_t* out_len) {
+  ProgramDef prog;
+  if (!prog.ParseFromArray(buf, static_cast<int>(len))) return 1;
+  *out = dup_bytes(prog.DebugString(), out_len);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""ctypes binding over the native Program IR library (program_desc.cc).
+
+Provides validate / prune / stats / text-dump on serialized ProgramDef
+bytes, with pure-Python fallbacks (io.prune, proto_io.program_to_text) when
+the toolchain is unavailable.  Counterpart of the reference's C++ desc +
+prune layer (paddle/framework/program_desc.cc, prune.cc)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "program_desc.cc")
+_PROTO_DIR = os.path.join(os.path.dirname(_HERE), "framework")
+_GEN_DIR = os.path.join(_HERE, "_gen")
+_LIB = os.path.join(_HERE, "libprogram_desc.so")
+
+
+def build_lib(force: bool = False) -> Optional[str]:
+    """protoc --cpp_out then g++ -shared (idempotent); None if unavailable."""
+    proto = os.path.join(_PROTO_DIR, "framework.proto")
+    newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(proto))
+    if not force and os.path.exists(_LIB) and (
+            os.path.getmtime(_LIB) >= newest_src):
+        return _LIB
+    try:
+        os.makedirs(_GEN_DIR, exist_ok=True)
+        subprocess.run(
+            ["protoc", f"--proto_path={_PROTO_DIR}",
+             f"--cpp_out={_GEN_DIR}", proto],
+            check=True, capture_output=True, timeout=120)
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             f"-I{_GEN_DIR}", "-o", _LIB, _SRC,
+             os.path.join(_GEN_DIR, "framework.pb.cc"), "-lprotobuf"],
+            check=True, capture_output=True, timeout=300)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+_dll = None
+
+
+def _lib():
+    global _dll
+    if _dll is not None:
+        return _dll
+    path = build_lib()
+    if path is None:
+        return None
+    try:
+        dll = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    dll.pt_desc_free.argtypes = [ctypes.c_void_p]
+    dll.pt_desc_validate.restype = ctypes.c_int
+    dll.pt_desc_validate.argtypes = [u8p, ctypes.c_uint64,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+    dll.pt_desc_prune.restype = ctypes.c_int
+    dll.pt_desc_prune.argtypes = [u8p, ctypes.c_uint64, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_void_p),
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    dll.pt_desc_stats.restype = ctypes.c_int
+    dll.pt_desc_stats.argtypes = [u8p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_void_p)]
+    dll.pt_desc_text.restype = ctypes.c_int
+    dll.pt_desc_text.argtypes = [u8p, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_void_p),
+                                 ctypes.POINTER(ctypes.c_uint64)]
+    _dll = dll
+    return dll
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+def _as_u8(data: bytes):
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), buf
+
+
+def _take_str(dll, ptr: ctypes.c_void_p, length=None) -> str:
+    if not ptr.value:
+        return ""
+    raw = ctypes.string_at(ptr.value, length) if length is not None \
+        else ctypes.string_at(ptr.value)
+    dll.pt_desc_free(ptr)
+    return raw.decode("utf-8", errors="replace")
+
+
+def validate(program_bytes: bytes) -> Tuple[bool, str]:
+    """(ok, diagnostics). Structural check of a serialized program."""
+    dll = _lib()
+    if dll is None:
+        return True, "native validator unavailable"
+    p, keep = _as_u8(program_bytes)
+    diag = ctypes.c_void_p()
+    rc = dll.pt_desc_validate(p, len(program_bytes), ctypes.byref(diag))
+    return rc == 0, _take_str(dll, diag)
+
+
+def prune(program_bytes: bytes, targets: List[str]) -> Optional[bytes]:
+    """Native backward-reachability prune; None if lib unavailable."""
+    dll = _lib()
+    if dll is None:
+        return None
+    p, keep = _as_u8(program_bytes)
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    rc = dll.pt_desc_prune(p, len(program_bytes),
+                           "\n".join(targets).encode(),
+                           ctypes.byref(out), ctypes.byref(out_len))
+    if rc != 0:
+        return None
+    raw = ctypes.string_at(out.value, out_len.value)
+    dll.pt_desc_free(out)
+    return raw
+
+
+def stats(program_bytes: bytes) -> Optional[str]:
+    """One JSON line of program stats; None if lib unavailable."""
+    dll = _lib()
+    if dll is None:
+        return None
+    p, keep = _as_u8(program_bytes)
+    out = ctypes.c_void_p()
+    if dll.pt_desc_stats(p, len(program_bytes), ctypes.byref(out)) != 0:
+        return None
+    return _take_str(dll, out)
+
+
+def text_dump(program_bytes: bytes) -> Optional[str]:
+    """DebugString dump; None if lib unavailable."""
+    dll = _lib()
+    if dll is None:
+        return None
+    p, keep = _as_u8(program_bytes)
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    if dll.pt_desc_text(p, len(program_bytes), ctypes.byref(out),
+                        ctypes.byref(out_len)) != 0:
+        return None
+    return _take_str(dll, out, out_len.value)

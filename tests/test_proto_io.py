@@ -1,0 +1,152 @@
+"""Proto IR interchange: Python round-trip + native desc library.
+
+Covers the durable ProgramDef contract (framework/framework.proto) the way
+the reference tests its desc layer (framework/program_desc_test.cc,
+prune_test.cc, python test_program.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import proto_io
+from paddle_tpu.native import program_desc as npd
+
+
+def _build_linear():
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return x, y, pred, cost
+
+
+def test_roundtrip_structural_equality():
+    _, _, pred, cost = _build_linear()
+    prog = fluid.default_main_program()
+    p2 = proto_io.parse_program(prog.to_proto())
+    assert len(p2.blocks) == len(prog.blocks)
+    for b1, b2 in zip(prog.blocks, p2.blocks):
+        assert [o.type for o in b1.ops] == [o.type for o in b2.ops]
+        for o1, o2 in zip(b1.ops, b2.ops):
+            assert o1.inputs == o2.inputs
+            assert o1.outputs == o2.outputs
+            assert o1.attrs == o2.attrs
+        assert ({n: v.to_dict() for n, v in b1.vars.items()}
+                == {n: v.to_dict() for n, v in b2.vars.items()})
+
+
+def test_roundtrip_with_control_flow_blocks():
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="float32", value=3)
+    acc = fluid.layers.fill_constant(shape=[4], dtype="float32", value=0.0)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with w.block():
+        nxt = fluid.layers.elementwise_add(acc, fluid.layers.mean(x))
+        fluid.layers.assign(nxt, acc)
+        fluid.layers.increment(i, 1.0)
+        fluid.layers.less_than(i, n, cond=cond)
+    prog = fluid.default_main_program()
+    assert len(prog.blocks) > 1
+    p2 = proto_io.parse_program(prog.to_proto())
+    assert len(p2.blocks) == len(prog.blocks)
+    subs1 = [op.attrs.get("sub_block") for b in prog.blocks for op in b.ops
+             if "sub_block" in op.attrs]
+    subs2 = [op.attrs.get("sub_block") for b in p2.blocks for op in b.ops
+             if "sub_block" in op.attrs]
+    assert subs1 == subs2 and subs1
+
+
+def test_roundtrip_executes_identically():
+    x, y, pred, cost = _build_linear()
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32),
+            "y": np.zeros((2, 1), np.float32)}
+    out1 = exe.run(prog, feed=feed, fetch_list=[cost])[0]
+    p2 = proto_io.parse_program(prog.to_proto())
+    out2 = exe.run(p2, feed=feed, fetch_list=[cost.name])[0]
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_text_dump():
+    _build_linear()
+    txt = proto_io.program_to_text(fluid.default_main_program())
+    assert "blocks" in txt and "mul" in txt
+
+
+@pytest.mark.skipif(not npd.native_available(),
+                    reason="native toolchain unavailable")
+class TestNativeDesc:
+    def test_validate_clean(self):
+        _build_linear()
+        ok, diag = npd.validate(fluid.default_main_program().to_proto())
+        assert ok, diag
+
+    def test_validate_catches_undeclared_input(self):
+        _build_linear()
+        prog = fluid.default_main_program()
+        bad = proto_io.program_to_proto(prog)
+        bad.blocks[0].ops[0].inputs[0].arguments.append("no_such_var")
+        ok, diag = npd.validate(bad.SerializeToString())
+        assert not ok
+        assert "no_such_var" in diag
+
+    def test_prune_matches_python(self):
+        from paddle_tpu import io as pio
+
+        _, _, pred, cost = _build_linear()
+        prog = fluid.default_main_program()
+        pruned_py = pio.prune(prog, [pred.name])
+        pruned_native = proto_io.parse_program(
+            npd.prune(prog.to_proto(), [pred.name]))
+        assert ([o.type for o in pruned_native.global_block().ops]
+                == [o.type for o in pruned_py.global_block().ops])
+
+    def test_prune_drops_dead_sub_blocks(self):
+        fluid.reset()
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="float32", value=2)
+        dead = fluid.layers.fill_constant(shape=[4], dtype="float32",
+                                          value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.assign(fluid.layers.elementwise_add(dead, h), dead)
+            fluid.layers.increment(i, 1.0)
+            fluid.layers.less_than(i, n, cond=cond)
+        prog = fluid.default_main_program()
+        assert len(prog.blocks) > 1
+        pruned = proto_io.parse_program(npd.prune(prog.to_proto(), [h.name]))
+        assert len(pruned.blocks) == 1
+        assert all("sub_block" not in op.attrs
+                   for op in pruned.global_block().ops)
+
+    def test_stats(self):
+        import json
+
+        _build_linear()
+        line = npd.stats(fluid.default_main_program().to_proto())
+        st = json.loads(line)
+        assert st["blocks"] == 1 and st["ops"] == 5 and st["params"] == 2
+
+
+def test_inference_model_proto_file(tmp_path):
+    x, y, pred, cost = _build_linear()
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    import os
+
+    assert os.path.exists(os.path.join(d, "__model__"))
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    feed = {"x": np.ones((3, 4), np.float32)}
+    out = exe.run(prog, feed=feed, fetch_list=fetches)[0]
+    assert np.asarray(out).shape == (3, 1)
