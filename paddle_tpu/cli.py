@@ -1,0 +1,148 @@
+"""The `paddle` command-line tool (reference paddle/scripts/
+submit_local.sh.in:173-198: `paddle train|pserver|version|merge_model|
+dump_config`), TPU edition.
+
+Usage: python -m paddle_tpu <subcommand> [args]
+
+  version               — framework + jax/device report
+  train --script S      — run a training script with the package on path
+  dump_config DIR|FILE  — text-proto dump of a saved model / __model__ file
+  stats DIR|FILE        — one JSON line of program stats (native lib)
+  merge_model DIR OUT   — bundle a saved inference model into one file
+  validate DIR|FILE     — structural check via the native desc library
+  pserver ...           — host parameter service (distributed/pserver)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _model_bytes(path: str) -> bytes:
+    """Accept a model dir (containing __model__) or a raw proto file."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def cmd_version(args) -> int:
+    import jax
+
+    import paddle_tpu
+
+    print(f"paddle_tpu {paddle_tpu.__version__}")
+    print(f"jax {jax.__version__}")
+    try:
+        print("devices:", ", ".join(str(d) for d in jax.devices()))
+    except RuntimeError as e:
+        print("devices: unavailable:", e)
+    return 0
+
+
+def cmd_train(args) -> int:
+    import runpy
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def cmd_dump_config(args) -> int:
+    data = _model_bytes(args.model)
+    from .native import program_desc as npd
+
+    txt = npd.text_dump(data)
+    if txt is None:  # toolchain-free fallback
+        from .framework import proto_io
+
+        txt = proto_io.program_to_text(proto_io.parse_program(data))
+    print(txt)
+    return 0
+
+
+def cmd_stats(args) -> int:
+    from .native import program_desc as npd
+
+    line = npd.stats(_model_bytes(args.model))
+    if line is None:
+        from .framework import proto_io
+
+        prog = proto_io.parse_program(_model_bytes(args.model))
+        line = json.dumps({
+            "blocks": len(prog.blocks),
+            "ops": sum(len(b.ops) for b in prog.blocks),
+            "vars": sum(len(b.vars) for b in prog.blocks),
+        })
+    print(line)
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from .native import program_desc as npd
+
+    ok, diag = npd.validate(_model_bytes(args.model))
+    if ok:
+        print("OK")
+        return 0
+    print(diag, file=sys.stderr)
+    return 1
+
+
+def cmd_merge_model(args) -> int:
+    from . import io
+
+    out = io.merge_model(args.model_dir, args.out)
+    print(out)
+    return 0
+
+
+def cmd_pserver(args) -> int:
+    from .distributed import pserver
+
+    pserver.serve_forever(host=args.host, port=args.port,
+                          num_trainers=args.num_trainers,
+                          checkpoint_dir=args.checkpoint_dir,
+                          checkpoint_period_s=args.checkpoint_period)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="paddle", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    p = sub.add_parser("train")
+    p.add_argument("--script", required=True)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_train)
+
+    for name, fn in (("dump_config", cmd_dump_config), ("stats", cmd_stats),
+                     ("validate", cmd_validate)):
+        p = sub.add_parser(name)
+        p.add_argument("model", help="saved model dir or __model__ file")
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("merge_model")
+    p.add_argument("model_dir")
+    p.add_argument("out")
+    p.set_defaults(fn=cmd_merge_model)
+
+    p = sub.add_parser("pserver")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7164)
+    p.add_argument("--num-trainers", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-period", type=float, default=600.0)
+    p.set_defaults(fn=cmd_pserver)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,611 @@
+"""Host parameter service: the capability equivalent of the reference's
+THREE parameter-server generations — C++ `ParameterServer2` (BSP barriers,
+async SGD, block-sharded params, sparse rows — paddle/pserver/
+ParameterServer2.cpp:250/362/457/559), the Go fault-tolerant pserver
+(InitParam/FinishInitParams/SendGrad/GetParam + disk checkpoint with etcd
+meta — go/pserver/service.go:229/260/285/311/346), and the fluid gRPC
+send/recv pair (operators/send_op.cc, recv_op.cc).
+
+On TPU, dense data-parallel gradients ride ICI all-reduce inside the
+compiled step — no pserver needed.  This service covers what stays on the
+host: embedding tables too large for HBM (sparse row updates), and
+cross-slice BSP/async coordination over DCN.  Transport is a
+length-prefixed JSON-header + raw-tensor-bytes protocol over TCP (the
+LightNetwork/ProtoServer role), with in-process use for tests.
+
+Server-side optimizers are numpy implementations of the standalone
+`paddle/optimizer` C library the Go pserver embedded (optimizer.go:51),
+with byte-serializable state (serialization.h parity) for checkpoints.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Server-side optimizers (paddle/optimizer C library parity)
+
+
+class HostOptimizer:
+    """Numpy update rule with serializable state."""
+
+    def __init__(self, lr: float = 0.01):
+        self.lr = lr
+
+    def update(self, param: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # Sparse row update: default = dense scatter of the row update rule.
+    def update_rows(self, param: np.ndarray, rows: np.ndarray,
+                    values: np.ndarray) -> np.ndarray:
+        dense = np.zeros_like(param)
+        np.add.at(dense, rows, values)
+        return self.update(param, dense)
+
+    def state_bytes(self) -> bytes:
+        buf = _io.BytesIO()
+        np.savez(buf, **self._state_arrays())
+        return buf.getvalue()
+
+    def load_state(self, data: bytes):
+        if not data:
+            return
+        loaded = np.load(_io.BytesIO(data))
+        self._set_state_arrays({k: loaded[k] for k in loaded.files})
+
+    def _state_arrays(self) -> Dict[str, np.ndarray]:
+        return {}
+
+    def _set_state_arrays(self, arrays: Dict[str, np.ndarray]):
+        pass
+
+
+class HostSGD(HostOptimizer):
+    def update(self, param, grad):
+        return param - self.lr * grad
+
+    def update_rows(self, param, rows, values):
+        out = param.copy()
+        np.subtract.at(out, rows, self.lr * values)
+        return out
+
+
+class HostMomentum(HostOptimizer):
+    def __init__(self, lr=0.01, momentum=0.9):
+        super().__init__(lr)
+        self.mu = momentum
+        self.velocity: Optional[np.ndarray] = None
+
+    def update(self, param, grad):
+        if self.velocity is None:
+            self.velocity = np.zeros_like(param)
+        self.velocity = self.mu * self.velocity + grad
+        return param - self.lr * self.velocity
+
+    def _state_arrays(self):
+        return {} if self.velocity is None else {"velocity": self.velocity}
+
+    def _set_state_arrays(self, arrays):
+        self.velocity = arrays.get("velocity")
+
+
+class HostAdagrad(HostOptimizer):
+    def __init__(self, lr=0.01, epsilon=1e-6):
+        super().__init__(lr)
+        self.eps = epsilon
+        self.moment: Optional[np.ndarray] = None
+
+    def update(self, param, grad):
+        if self.moment is None:
+            self.moment = np.zeros_like(param)
+        self.moment = self.moment + grad * grad
+        return param - self.lr * grad / (np.sqrt(self.moment) + self.eps)
+
+    def update_rows(self, param, rows, values):
+        # Sparse: only touched rows accumulate moment (SparseRowMatrix
+        # semantics — rows never seen keep zero state).
+        if self.moment is None:
+            self.moment = np.zeros_like(param)
+        out = param.copy()
+        np.add.at(self.moment, rows, values * values)
+        denom = np.sqrt(self.moment[rows]) + self.eps
+        np.subtract.at(out, rows, self.lr * values / denom)
+        return out
+
+    def _state_arrays(self):
+        return {} if self.moment is None else {"moment": self.moment}
+
+    def _set_state_arrays(self, arrays):
+        self.moment = arrays.get("moment")
+
+
+class HostAdam(HostOptimizer):
+    def __init__(self, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        super().__init__(lr)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+        self.m: Optional[np.ndarray] = None
+        self.v: Optional[np.ndarray] = None
+        self.t = 0
+
+    def update(self, param, grad):
+        if self.m is None:
+            self.m = np.zeros_like(param)
+            self.v = np.zeros_like(param)
+        self.t += 1
+        self.m = self.b1 * self.m + (1 - self.b1) * grad
+        self.v = self.b2 * self.v + (1 - self.b2) * grad * grad
+        mhat = self.m / (1 - self.b1 ** self.t)
+        vhat = self.v / (1 - self.b2 ** self.t)
+        return param - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def _state_arrays(self):
+        if self.m is None:
+            return {}
+        return {"m": self.m, "v": self.v, "t": np.array([self.t])}
+
+    def _set_state_arrays(self, arrays):
+        self.m = arrays.get("m")
+        self.v = arrays.get("v")
+        self.t = int(arrays["t"][0]) if "t" in arrays else 0
+
+
+_OPTIMIZERS = {"sgd": HostSGD, "momentum": HostMomentum,
+               "adagrad": HostAdagrad, "adam": HostAdam}
+
+
+def make_optimizer(cfg: dict) -> HostOptimizer:
+    cfg = dict(cfg or {"type": "sgd"})
+    return _OPTIMIZERS[cfg.pop("type", "sgd")](**cfg)
+
+
+# ---------------------------------------------------------------------------
+# Service core (in-process)
+
+
+class ParameterServerService:
+    """Parameter blocks + server-side optimize, BSP or async.
+
+    BSP (ParameterService.proto:24 PSERVER_UPDATE_MODE_ADD_GRADIENT):
+    `send_grad` accumulates; once `num_trainers` distinct trainers have
+    contributed this round, the optimizer applies the averaged gradient and
+    the round barrier releases every waiter.  Async
+    (PSERVER_UPDATE_MODE_ASYNC_SGD): each gradient applies immediately.
+    """
+
+    def __init__(self, num_trainers: int = 1, mode: str = "bsp",
+                 checkpoint_dir: Optional[str] = None):
+        assert mode in ("bsp", "async")
+        self.num_trainers = num_trainers
+        self.mode = mode
+        self.checkpoint_dir = checkpoint_dir
+        self._params: Dict[str, np.ndarray] = {}
+        self._opts: Dict[str, HostOptimizer] = {}
+        self._opt_cfgs: Dict[str, dict] = {}
+        self._init_done = False
+        self._lock = threading.Lock()
+        self._round_cv = threading.Condition(self._lock)
+        self._round = 0
+        self._acc: Dict[str, np.ndarray] = {}
+        self._contributed: set = set()
+        self._pass_cv = threading.Condition(self._lock)
+        self._pass_waiting = 0
+        self._pass_no = 0
+
+    # -- init barrier (service.go:229/260: trainer 0 seeds params) ----------
+    def init_param(self, name: str, value: np.ndarray,
+                   optimizer_cfg: Optional[dict] = None):
+        with self._lock:
+            if self._init_done:
+                raise RuntimeError("init after finish_init_params")
+            self._params[name] = np.array(value, copy=True)
+            self._opt_cfgs[name] = dict(optimizer_cfg or {"type": "sgd"})
+            self._opts[name] = make_optimizer(optimizer_cfg)
+
+    def finish_init_params(self):
+        with self._lock:
+            self._init_done = True
+
+    def initialized(self) -> bool:
+        with self._lock:
+            return self._init_done
+
+    # -- gradient path (service.go:285 SendGrad / PS2.cpp:362 addGradient) --
+    def send_grad(self, trainer_id: str, grads: Dict[str, np.ndarray],
+                  timeout: Optional[float] = 120.0):
+        with self._round_cv:
+            if not self._init_done:
+                raise RuntimeError("send_grad before FinishInitParams")
+            if self.mode == "async":
+                for name, g in grads.items():
+                    self._params[name] = self._opts[name].update(
+                        self._params[name], np.asarray(g))
+                return
+            for name, g in grads.items():
+                g = np.asarray(g)
+                self._acc[name] = self._acc.get(name, 0) + g
+            self._contributed.add(trainer_id)
+            my_round = self._round
+            if len(self._contributed) >= self.num_trainers:
+                for name, total in self._acc.items():
+                    avg = total / float(self.num_trainers)
+                    self._params[name] = self._opts[name].update(
+                        self._params[name], avg)
+                self._acc = {}
+                self._contributed = set()
+                self._round += 1
+                self._round_cv.notify_all()
+            else:
+                # BSP barrier: block until this round's update is applied
+                if not self._round_cv.wait_for(
+                        lambda: self._round > my_round, timeout=timeout):
+                    raise TimeoutError(
+                        f"BSP round {my_round}: peers missing after "
+                        f"{timeout}s")
+
+    def send_sparse_grad(self, trainer_id: str, name: str,
+                         rows: np.ndarray, values: np.ndarray):
+        """SelectedRows gradient: update only `rows` of the table (sparse
+        pserver path — RemoteParameterUpdater.h:265, SparseRowMatrix).
+        Always applied immediately (async), matching the reference's
+        sparse-remote behavior of row-level updates."""
+        with self._lock:
+            if not self._init_done:
+                raise RuntimeError("send_grad before FinishInitParams")
+            self._params[name] = self._opts[name].update_rows(
+                self._params[name], np.asarray(rows), np.asarray(values))
+
+    # -- fetch (service.go:311 GetParam / PS2.cpp:559 getParameter) ---------
+    def get_param(self, name: str) -> np.ndarray:
+        with self._lock:
+            return self._params[name].copy()
+
+    def get_param_rows(self, name: str, rows: np.ndarray) -> np.ndarray:
+        """Sparse prefetch: only needed rows travel (getParameterSparse)."""
+        with self._lock:
+            return self._params[name][np.asarray(rows)].copy()
+
+    def param_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._params)
+
+    # -- pass barriers (PS2 waitPassStart/waitPassFinish) -------------------
+    def wait_pass_barrier(self, timeout: Optional[float] = 120.0) -> int:
+        """All trainers rendezvous; returns the new pass number."""
+        with self._pass_cv:
+            self._pass_waiting += 1
+            if self._pass_waiting >= self.num_trainers:
+                self._pass_waiting = 0
+                self._pass_no += 1
+                self._pass_cv.notify_all()
+                return self._pass_no
+            target = self._pass_no + 1
+            if not self._pass_cv.wait_for(
+                    lambda: self._pass_no >= target, timeout=timeout):
+                raise TimeoutError("pass barrier timeout")
+            return self._pass_no
+
+    # -- checkpoint (service.go:346 checkpoint / :175 LoadCheckpoint) -------
+    def save_checkpoint(self, dirname: Optional[str] = None) -> str:
+        dirname = dirname or self.checkpoint_dir
+        assert dirname, "no checkpoint dir configured"
+        os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            blob_path = os.path.join(dirname, "pserver.npz")
+            arrays = dict(self._params)
+            for name, opt in self._opts.items():
+                arrays[f"__optstate__{name}"] = np.frombuffer(
+                    opt.state_bytes(), dtype=np.uint8)
+            buf = _io.BytesIO()
+            np.savez(buf, **arrays)
+            blob = buf.getvalue()
+            with open(blob_path, "wb") as f:
+                f.write(blob)
+            meta = {
+                "md5": hashlib.md5(blob).hexdigest(),
+                "path": blob_path,
+                "timestamp": time.time(),
+                "round": self._round,
+                "pass": self._pass_no,
+                "opt_cfgs": self._opt_cfgs,
+            }
+            with open(os.path.join(dirname, "pserver.meta.json"), "w") as f:
+                json.dump(meta, f)
+        return blob_path
+
+    def load_checkpoint(self, dirname: Optional[str] = None) -> bool:
+        dirname = dirname or self.checkpoint_dir
+        meta_path = os.path.join(dirname or "", "pserver.meta.json")
+        if not dirname or not os.path.exists(meta_path):
+            return False
+        with open(meta_path) as f:
+            meta = json.load(f)
+        with open(meta["path"], "rb") as f:
+            blob = f.read()
+        if hashlib.md5(blob).hexdigest() != meta["md5"]:
+            raise RuntimeError("pserver checkpoint md5 mismatch")
+        loaded = np.load(_io.BytesIO(blob))
+        with self._lock:
+            self._opt_cfgs = dict(meta.get("opt_cfgs", {}))
+            self._params = {}
+            self._opts = {}
+            for key in loaded.files:
+                if key.startswith("__optstate__"):
+                    continue
+                self._params[key] = loaded[key]
+                cfg = self._opt_cfgs.get(key, {"type": "sgd"})
+                opt = make_optimizer(cfg)
+                state_key = f"__optstate__{key}"
+                if state_key in loaded.files:
+                    opt.load_state(loaded[state_key].tobytes())
+                self._opts[key] = opt
+            self._round = int(meta.get("round", 0))
+            self._pass_no = int(meta.get("pass", 0))
+            self._init_done = True
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol: 4-byte header length | JSON header | raw payload bytes.
+# Arrays travel as raw bytes described by header dtype/shape fields.
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">II", len(h), len(payload)) + h + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    hlen, plen = struct.unpack(">II", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+def _pack_array(a: np.ndarray) -> Tuple[dict, bytes]:
+    a = np.ascontiguousarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape)}, a.tobytes()
+
+
+def _unpack_array(desc: dict, payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, dtype=desc["dtype"]).reshape(
+        desc["shape"]).copy()
+
+
+class _PServerHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        svc: ParameterServerService = self.server.service  # type: ignore
+        try:
+            while True:
+                header, payload = _recv_msg(self.request)
+                try:
+                    reply, out = self._dispatch(svc, header, payload)
+                except (RuntimeError, KeyError, TimeoutError) as e:
+                    reply, out = {"ok": False, "error": str(e)}, b""
+                _send_msg(self.request, reply, out)
+        except (ConnectionError, OSError):
+            return
+
+    def _dispatch(self, svc, header, payload):
+        op = header["op"]
+        if op == "init_param":
+            svc.init_param(header["name"],
+                           _unpack_array(header["array"], payload),
+                           header.get("optimizer"))
+            return {"ok": True}, b""
+        if op == "finish_init":
+            svc.finish_init_params()
+            return {"ok": True}, b""
+        if op == "initialized":
+            return {"ok": True, "value": svc.initialized()}, b""
+        if op == "send_grad":
+            descs = header["arrays"]
+            grads, off = {}, 0
+            for d in descs:
+                n = int(np.prod(d["shape"])) * np.dtype(d["dtype"]).itemsize
+                grads[d["name"]] = _unpack_array(d, payload[off:off + n])
+                off += n
+            svc.send_grad(header["trainer_id"], grads)
+            return {"ok": True}, b""
+        if op == "send_sparse_grad":
+            rd, vd = header["rows"], header["values"]
+            rn = int(np.prod(rd["shape"])) * np.dtype(rd["dtype"]).itemsize
+            rows = _unpack_array(rd, payload[:rn])
+            values = _unpack_array(vd, payload[rn:])
+            svc.send_sparse_grad(header["trainer_id"], header["name"],
+                                 rows, values)
+            return {"ok": True}, b""
+        if op == "get_param":
+            desc, out = _pack_array(svc.get_param(header["name"]))
+            return {"ok": True, "array": desc}, out
+        if op == "get_param_rows":
+            rows = _unpack_array(header["rows"], payload)
+            desc, out = _pack_array(svc.get_param_rows(header["name"], rows))
+            return {"ok": True, "array": desc}, out
+        if op == "param_names":
+            return {"ok": True, "value": svc.param_names()}, b""
+        if op == "pass_barrier":
+            return {"ok": True, "value": svc.wait_pass_barrier()}, b""
+        if op == "save_checkpoint":
+            return {"ok": True,
+                    "value": svc.save_checkpoint(header.get("dir"))}, b""
+        raise RuntimeError(f"unknown op {op!r}")
+
+
+class PServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host="127.0.0.1", port=0, num_trainers=1, mode="bsp",
+                 checkpoint_dir=None):
+        super().__init__((host, port), _PServerHandler)
+        self.service = ParameterServerService(
+            num_trainers=num_trainers, mode=mode,
+            checkpoint_dir=checkpoint_dir)
+        if checkpoint_dir:
+            self.service.load_checkpoint(checkpoint_dir)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.server_address[0]}:{self.server_address[1]}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+class ParameterClient:
+    """Trainer-side client (go/pserver/client/c/cclient.go exports /
+    ParameterClient2).  Parameters are assigned to pservers by name hash
+    (client.go selects pserver by name hash); each param lives wholly on
+    one server, matching the Go design."""
+
+    def __init__(self, endpoints: List[str], trainer_id: str = "0"):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self._socks: Dict[str, socket.socket] = {}
+
+    def _sock(self, endpoint: str) -> socket.socket:
+        if endpoint not in self._socks:
+            host, port = endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=300)
+            self._socks[endpoint] = s
+        return self._socks[endpoint]
+
+    def _server_for(self, name: str) -> str:
+        h = int(hashlib.md5(name.encode()).hexdigest(), 16)
+        return self.endpoints[h % len(self.endpoints)]
+
+    def _call(self, endpoint, header, payload=b""):
+        sock = self._sock(endpoint)
+        _send_msg(sock, header, payload)
+        reply, out = _recv_msg(sock)
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error", "pserver error"))
+        return reply, out
+
+    # paddle_begin_init_params / paddle_init_param / finish (cclient.go)
+    def init_param(self, name, value, optimizer=None):
+        desc, payload = _pack_array(np.asarray(value))
+        self._call(self._server_for(name),
+                   {"op": "init_param", "name": name, "array": desc,
+                    "optimizer": optimizer}, payload)
+
+    def finish_init_params(self):
+        for ep in self.endpoints:
+            self._call(ep, {"op": "finish_init"})
+
+    def initialized(self) -> bool:
+        return all(self._call(ep, {"op": "initialized"})[0]["value"]
+                   for ep in self.endpoints)
+
+    def send_grads(self, grads: Dict[str, np.ndarray]):
+        by_server: Dict[str, dict] = {}
+        for name, g in grads.items():
+            by_server.setdefault(self._server_for(name), {})[name] = g
+        # every server this trainer talks to must see one contribution per
+        # round, even if no grads hash there
+        for ep in self.endpoints:
+            batch = by_server.get(ep, {})
+            descs, chunks = [], []
+            for name, g in batch.items():
+                d, b = _pack_array(np.asarray(g))
+                d["name"] = name
+                descs.append(d)
+                chunks.append(b)
+            self._call(ep, {"op": "send_grad",
+                            "trainer_id": self.trainer_id,
+                            "arrays": descs}, b"".join(chunks))
+
+    def send_sparse_grad(self, name, rows, values):
+        rd, rb = _pack_array(np.asarray(rows))
+        vd, vb = _pack_array(np.asarray(values))
+        self._call(self._server_for(name),
+                   {"op": "send_sparse_grad", "trainer_id": self.trainer_id,
+                    "name": name, "rows": rd, "values": vd}, rb + vb)
+
+    def get_param(self, name) -> np.ndarray:
+        reply, out = self._call(self._server_for(name),
+                                {"op": "get_param", "name": name})
+        return _unpack_array(reply["array"], out)
+
+    def get_param_rows(self, name, rows) -> np.ndarray:
+        rd, rb = _pack_array(np.asarray(rows))
+        reply, out = self._call(self._server_for(name),
+                                {"op": "get_param_rows", "name": name,
+                                 "rows": rd}, rb)
+        return _unpack_array(reply["array"], out)
+
+    def get_params(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for ep in self.endpoints:
+            names = self._call(ep, {"op": "param_names"})[0]["value"]
+            for n in names:
+                reply, raw = self._call(ep, {"op": "get_param", "name": n})
+                out[n] = _unpack_array(reply["array"], raw)
+        return out
+
+    def pass_barrier(self) -> int:
+        vals = [self._call(ep, {"op": "pass_barrier"})[0]["value"]
+                for ep in self.endpoints]
+        return max(vals)
+
+    def save_checkpoint(self, dirname=None):
+        return [self._call(ep, {"op": "save_checkpoint", "dir": dirname})[0]
+                ["value"] for ep in self.endpoints]
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+
+def serve_forever(host="127.0.0.1", port=7164, num_trainers=1, mode="bsp",
+                  checkpoint_dir=None, checkpoint_period_s=600.0):
+    """Blocking entry for `paddle pserver` (ParameterServer2Main.cpp:20 /
+    cmd/pserver/pserver.go)."""
+    server = PServer(host=host, port=port, num_trainers=num_trainers,
+                     mode=mode, checkpoint_dir=checkpoint_dir)
+    if checkpoint_dir:
+        def _periodic():
+            while True:
+                time.sleep(checkpoint_period_s)
+                try:
+                    server.service.save_checkpoint(checkpoint_dir)
+                except (OSError, RuntimeError):
+                    pass
+        threading.Thread(target=_periodic, daemon=True).start()
+    print(f"pserver listening on {server.endpoint} "
+          f"(num_trainers={num_trainers}, mode={mode})")
+    server.serve_forever()

@@ -26,6 +26,11 @@ def _compute_requires_grad(block, no_grad_set: Set[str]) -> Set[str]:
     for v in block.vars.values():
         if isinstance(v, Parameter) and v.trainable and v.name not in no_grad_set:
             req.add(v.name)
+        # A feed explicitly un-stopped wants d(loss)/d(feed) — the host
+        # offloaded-embedding path (SparseRemoteParameterUpdater parity)
+        # fetches it to push row updates back to the parameter service.
+        elif v.is_data and not v.stop_gradient and v.name not in no_grad_set:
+            req.add(v.name)
     for op in block.ops:
         info = get_op_info(op.type)
         if info.grad is None:
@@ -192,6 +197,12 @@ def append_backward(
         g = finalize(p.name)
         if g is not None:
             result.append((p, block.var(g)))
-    if not result:
+    # materialize grads of un-stopped feeds so they are fetchable
+    feed_grads = 0
+    for v in list(block.vars.values()):
+        if v.is_data and not v.stop_gradient:
+            if finalize(v.name) is not None:
+                feed_grads += 1
+    if not result and not feed_grads:
         raise ValueError("append_backward produced no parameter gradients")
     return result

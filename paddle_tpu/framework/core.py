@@ -432,11 +432,17 @@ class Program:
         p.current_block_idx = d.get("current_block_idx", 0)
         p.random_seed = d.get("random_seed", 0)
         p._version = 0
-        p._next_uid = 1 + max(
-            (int(op.attrs.get("__uid__", 0)) for b in p.blocks for op in b.ops),
+        p._recompute_next_uid()
+        return p
+
+    def _recompute_next_uid(self):
+        """Restore the uid counter after deserialization so future ops never
+        collide with recorded __uid__ PRNG salts."""
+        self._next_uid = 1 + max(
+            (int(op.attrs.get("__uid__", 0))
+             for b in self.blocks for op in b.ops),
             default=-1,
         )
-        return p
 
 
 # ---------------------------------------------------------------------------

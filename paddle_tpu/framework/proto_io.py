@@ -52,7 +52,9 @@ def framework_pb2():
 # ---------------------------------------------------------------------------
 # Attribute encode/decode
 
-_BLOCK_ATTRS = {"sub_block"}  # attr names that refer to nested blocks
+# attr names that refer to nested blocks (while/static_rnn use sub_block;
+# cond uses a block per branch — control_flow.py)
+_BLOCK_ATTRS = {"sub_block", "true_block", "false_block"}
 
 
 def _encode_attr(pb_attr, name, value):
@@ -218,11 +220,7 @@ def proto_to_program(pdef):
             outputs = {s.name: list(s.arguments) for s in odef.outputs}
             attrs = {a.name: _decode_attr(a) for a in odef.attrs}
             block.ops.append(Operator(block, odef.type, inputs, outputs, attrs))
-    program._next_uid = 1 + max(
-        (int(op.attrs.get("__uid__", 0))
-         for b in program.blocks for op in b.ops),
-        default=-1,
-    )
+    program._recompute_next_uid()
     return program
 
 

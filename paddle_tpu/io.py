@@ -123,13 +123,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     if model_bytes is not None:
         from .native import program_desc as _npd
 
-        # Only validate against an already-built library — a model save
-        # should not trigger a C++ compile as a side effect.
-        if os.path.exists(_npd._LIB):
-            ok, diag = _npd.validate(model_bytes)
-            if not ok:
-                raise ValueError(
-                    f"inference program failed validation:\n{diag}")
+        # build=False: a model save must never trigger a C++ compile as a
+        # side effect; validation runs only against a pre-built library.
+        ok, diag = _npd.validate(model_bytes, build=False)
+        if not ok:
+            raise ValueError(f"inference program failed validation:\n{diag}")
     with open(os.path.join(dirname, "program.json"), "w") as f:
         f.write(inference_program.to_json())
     if model_bytes is not None:
@@ -169,3 +167,27 @@ def load_inference_model(dirname, executor, scope=None):
         meta = json.load(f)
     load_persistables(executor, dirname, scope=scope)
     return program, meta["feed_var_names"], meta["fetch_var_names"]
+
+
+def merge_model(model_dir, out_path):
+    """Bundle a saved inference model dir into ONE deployable file
+    (`paddle merge_model` parity — reference submit_local.sh.in:186,
+    tools merge config+params for C-API deployment).  Format: gzipped tar
+    of the model dir contents."""
+    import tarfile
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        for fname in sorted(os.listdir(model_dir)):
+            tar.add(os.path.join(model_dir, fname), arcname=fname)
+    return out_path
+
+
+def load_merged_model(path, executor, scope=None):
+    """Load a merge_model bundle → (program, feed_names, fetch_names)."""
+    import tarfile
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with tarfile.open(path, "r:gz") as tar:
+            tar.extractall(tmp, filter="data")
+        return load_inference_model(tmp, executor, scope=scope)

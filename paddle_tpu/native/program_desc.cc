@@ -36,9 +36,13 @@ char* dup_bytes(const std::string& s, uint64_t* out_len) {
   return p;
 }
 
-// Does `name` resolve in block `idx` or any ancestor block?
+// Does `name` resolve in block `idx` or any ancestor block?  Hop count is
+// bounded by blocks_size() so cyclic parent_idx in corrupt bytes cannot
+// wedge the validator.
 bool resolves(const ProgramDef& prog, int idx, const std::string& name) {
-  while (idx >= 0 && idx < prog.blocks_size()) {
+  for (int hops = 0;
+       idx >= 0 && idx < prog.blocks_size() && hops <= prog.blocks_size();
+       ++hops) {
     const BlockDef& b = prog.blocks(idx);
     for (const VarDef& v : b.vars())
       if (v.name() == name) return true;
@@ -47,10 +51,13 @@ bool resolves(const ProgramDef& prog, int idx, const std::string& name) {
   return false;
 }
 
-int sub_block_attr(const OpDef& op) {
+// All nested-block references of an op (while has one sub_block; cond has
+// a block per branch).
+std::vector<int> block_attrs(const OpDef& op) {
+  std::vector<int> out;
   for (const AttrValue& a : op.attrs())
-    if (a.kind() == AttrValue::BLOCK) return a.block_idx();
-  return -1;
+    if (a.kind() == AttrValue::BLOCK) out.push_back(a.block_idx());
+  return out;
 }
 
 // Backward-reachability prune of one block: keep ops any of whose outputs
@@ -80,10 +87,9 @@ void prune_block(ProgramDef* prog, int block_idx,
 // Blocks referenced (transitively) from block 0 after pruning.
 void live_blocks(const ProgramDef& prog, int idx, std::set<int>* live) {
   if (!live->insert(idx).second) return;
-  for (const OpDef& op : prog.blocks(idx).ops()) {
-    int sub = sub_block_attr(op);
-    if (sub >= 0 && sub < prog.blocks_size()) live_blocks(prog, sub, live);
-  }
+  for (const OpDef& op : prog.blocks(idx).ops())
+    for (int sub : block_attrs(op))
+      if (sub >= 0 && sub < prog.blocks_size()) live_blocks(prog, sub, live);
 }
 
 }  // namespace
@@ -116,10 +122,10 @@ int pt_desc_validate(const uint8_t* buf, uint64_t len, char** diag) {
       if (v.persistable() || v.is_data()) produced.insert(v.name());
     for (int oi = 0; oi < b.ops_size(); ++oi) {
       const OpDef& op = b.ops(oi);
-      int sub = sub_block_attr(op);
-      if (sub >= prog.blocks_size())
-        out << "block " << bi << " op " << oi << " (" << op.type()
-            << "): sub_block " << sub << " out of range\n";
+      for (int sub : block_attrs(op))
+        if (sub >= prog.blocks_size())
+          out << "block " << bi << " op " << oi << " (" << op.type()
+              << "): sub_block " << sub << " out of range\n";
       for (const auto& slot : op.inputs())
         for (const auto& arg : slot.arguments()) {
           if (arg.empty()) continue;

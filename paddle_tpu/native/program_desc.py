@@ -45,11 +45,16 @@ def build_lib(force: bool = False) -> Optional[str]:
 _dll = None
 
 
-def _lib():
+def _lib(build: bool = True):
     global _dll
     if _dll is not None:
         return _dll
-    path = build_lib()
+    if build:
+        path = build_lib()
+    else:
+        # no-build mode: load a pre-existing library only — callers on a
+        # latency-sensitive path (model save) must never trigger a compile
+        path = _LIB if os.path.exists(_LIB) else None
     if path is None:
         return None
     try:
@@ -94,9 +99,9 @@ def _take_str(dll, ptr: ctypes.c_void_p, length=None) -> str:
     return raw.decode("utf-8", errors="replace")
 
 
-def validate(program_bytes: bytes) -> Tuple[bool, str]:
+def validate(program_bytes: bytes, build: bool = True) -> Tuple[bool, str]:
     """(ok, diagnostics). Structural check of a serialized program."""
-    dll = _lib()
+    dll = _lib(build=build)
     if dll is None:
         return True, "native validator unavailable"
     p, keep = _as_u8(program_bytes)

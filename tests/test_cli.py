@@ -1,0 +1,57 @@
+"""`paddle` CLI subcommands (reference submit_local.sh.in:173-198)."""
+
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import cli
+
+
+def _saved_model(tmp_path):
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    pred = fluid.layers.fc(x, size=2, act="softmax")
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    return d, pred
+
+
+def test_version(capsys):
+    assert cli.main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "paddle_tpu" in out and "jax" in out
+
+
+def test_dump_config_and_stats(tmp_path, capsys):
+    d, _ = _saved_model(tmp_path)
+    assert cli.main(["dump_config", d]) == 0
+    assert "mul" in capsys.readouterr().out
+    assert cli.main(["stats", d]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["ops"] >= 2
+
+
+def test_validate(tmp_path, capsys):
+    d, _ = _saved_model(tmp_path)
+    assert cli.main(["validate", d]) == 0
+
+
+def test_merge_model_roundtrip(tmp_path, capsys):
+    d, pred = _saved_model(tmp_path)
+    bundle = str(tmp_path / "model.paddle")
+    assert cli.main(["merge_model", d, bundle]) == 0
+    exe = fluid.Executor(fluid.default_place())
+    prog, feeds, fetches = fluid.io.load_merged_model(bundle, exe)
+    out = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                  fetch_list=fetches)[0]
+    assert np.asarray(out).shape == (2, 2)
+
+
+def test_train_runs_script(tmp_path, capsys):
+    script = tmp_path / "train.py"
+    script.write_text("print('hello-from-train')\n")
+    assert cli.main(["train", "--script", str(script)]) == 0
+    assert "hello-from-train" in capsys.readouterr().out

@@ -1,0 +1,65 @@
+"""Host-offloaded embedding training: the sparse-remote parameter path
+(reference SparseRemoteParameterUpdater + go pserver sparse rows), with
+the dense model on-device and the table on the parameter service."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.host_embedding import HostEmbedding
+from paddle_tpu.distributed.pserver import ParameterServerService
+
+
+def test_ctr_with_host_table_trains():
+    VOCAB, DIM, B = 1000, 8, 32
+    svc = ParameterServerService(num_trainers=1)
+    table = HostEmbedding(svc, "emb_table", VOCAB, DIM,
+                          optimizer={"type": "adagrad", "lr": 0.5})
+    svc.finish_init_params()
+
+    fluid.reset()
+    emb = fluid.layers.data(name="emb", shape=[DIM], dtype="float32")
+    emb.stop_gradient = False
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(emb, size=1, act="sigmoid")
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.2).minimize(cost)
+
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    # ground truth: even ids → 1, odd ids → 0 (learnable only via the table)
+    first = last = None
+    for step in range(60):
+        ids = rng.randint(0, VOCAB, size=B)
+        labels = (ids % 2 == 0).astype(np.float32).reshape(B, 1)
+        vecs = table.fetch(ids)
+        c, g = exe.run(feed={"emb": vecs, "y": labels},
+                       fetch_list=[cost, "emb@GRAD"])
+        table.push_grad(ids, np.asarray(g))
+        c = float(np.asarray(c))
+        if first is None:
+            first = c
+        last = c
+    assert last < first * 0.6, (first, last)
+    # rows never touched remain at their init (no dense write-back)
+    untouched = svc.get_param_rows(
+        "emb_table", np.array([VOCAB - 1]))
+    assert untouched.shape == (1, DIM)
+
+
+def test_fetch_push_dedup_semantics():
+    svc = ParameterServerService(num_trainers=1)
+    t = HostEmbedding(svc, "t", 10, 2, optimizer={"type": "sgd", "lr": 1.0},
+                      init_scale=0.0)
+    svc.finish_init_params()
+    vecs = t.fetch(np.array([3, 3, 5]))
+    assert vecs.shape == (3, 2)
+    np.testing.assert_array_equal(vecs[0], vecs[1])
+    # duplicate ids sum their gradients into one row update
+    t.push_grad(np.array([3, 3, 5]),
+                np.ones((3, 2), np.float32))
+    got = svc.get_param("t")
+    np.testing.assert_allclose(got[3], [-2.0, -2.0])
+    np.testing.assert_allclose(got[5], [-1.0, -1.0])
+    assert np.all(got[[0, 1, 2, 4, 6, 7, 8, 9]] == 0)

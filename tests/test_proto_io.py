@@ -150,3 +150,67 @@ def test_inference_model_proto_file(tmp_path):
     feed = {"x": np.ones((3, 4), np.float32)}
     out = exe.run(prog, feed=feed, fetch_list=fetches)[0]
     assert np.asarray(out).shape == (3, 1)
+
+
+def test_cond_branch_blocks_survive_roundtrip_and_prune():
+    """cond's true_block/false_block are BLOCK attrs: prune must keep both
+    branch sub-blocks and remap their indices."""
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    flag = fluid.layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+    zero = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    pred = fluid.layers.less_than(zero, flag)
+    out = fluid.layers.ifelse(pred,
+                              lambda: fluid.layers.mean(x) * 2.0,
+                              lambda: fluid.layers.mean(x) * 3.0)
+    prog = fluid.default_main_program()
+    data = prog.to_proto()
+    # BLOCK kind on the wire
+    pdef = proto_io.program_to_proto(prog)
+    kinds = {a.name: a.kind for b in pdef.blocks for o in b.ops
+             for a in o.attrs if a.name in ("true_block", "false_block")}
+    K = proto_io.framework_pb2().AttrValue.Kind
+    assert kinds and all(k == K.BLOCK for k in kinds.values())
+    if npd.native_available():
+        pruned = proto_io.parse_program(npd.prune(data, [out.name]))
+        assert len(pruned.blocks) == len(prog.blocks)
+        exe = fluid.Executor(fluid.default_place())
+        got = exe.run(pruned, feed={"x": np.ones((2, 4), np.float32)},
+                      fetch_list=[out.name])[0]
+        np.testing.assert_allclose(np.asarray(got).reshape(-1), [2.0], rtol=1e-6)
+
+
+@pytest.mark.skipif(not npd.native_available(), reason="no native lib")
+def test_validate_survives_cyclic_parent_idx():
+    _build_linear()
+    pdef = proto_io.program_to_proto(fluid.default_main_program())
+    b1 = pdef.blocks.add()
+    b1.idx = 1
+    b1.parent_idx = 2
+    b2 = pdef.blocks.add()
+    b2.idx = 2
+    b2.parent_idx = 1
+    op = b1.ops.add()
+    op.type = "mean"
+    s = op.inputs.add()
+    s.name = "X"
+    s.arguments.append("undeclared_var")
+    ok, diag = npd.validate(pdef.SerializeToString())
+    assert not ok and "undeclared_var" in diag
+
+
+def test_feed_only_backward_for_host_embedding():
+    """d(loss)/d(feed) without any trainable parameter (pure host-offload
+    serving path) must not raise."""
+    from paddle_tpu.framework.backward import append_backward
+
+    fluid.reset()
+    emb = fluid.layers.data(name="emb", shape=[8], dtype="float32")
+    emb.stop_gradient = False
+    loss = fluid.layers.mean(fluid.layers.elementwise_mul(emb, emb))
+    append_backward(loss)
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    g = exe.run(feed={"emb": np.ones((2, 8), np.float32)},
+                fetch_list=["emb@GRAD"])[0]
+    assert np.asarray(g).shape == (2, 8)

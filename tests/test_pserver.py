@@ -1,0 +1,132 @@
+"""Host parameter service (reference go/pserver/{service,client}_test.go,
+paddle/pserver ParameterServer2 BSP/async/sparse semantics)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.pserver import (
+    ParameterClient, ParameterServerService, PServer)
+
+
+def test_init_barrier_and_get():
+    svc = ParameterServerService(num_trainers=1)
+    svc.init_param("w", np.ones((4, 2), np.float32))
+    with pytest.raises(RuntimeError):
+        svc.send_grad("0", {"w": np.zeros((4, 2), np.float32)})
+    svc.finish_init_params()
+    np.testing.assert_array_equal(svc.get_param("w"), np.ones((4, 2)))
+
+
+def test_bsp_averages_across_trainers():
+    svc = ParameterServerService(num_trainers=2, mode="bsp")
+    svc.init_param("w", np.zeros(3, np.float32), {"type": "sgd", "lr": 1.0})
+    svc.finish_init_params()
+    g0 = np.array([1.0, 0.0, 0.0], np.float32)
+    g1 = np.array([0.0, 1.0, 0.0], np.float32)
+    t = threading.Thread(target=svc.send_grad, args=("t1", {"w": g1}))
+    t.start()
+    svc.send_grad("t0", {"w": g0})  # releases once both contributed
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # param -= lr * mean(g0, g1)
+    np.testing.assert_allclose(svc.get_param("w"), [-0.5, -0.5, 0.0])
+
+
+def test_async_applies_immediately():
+    svc = ParameterServerService(num_trainers=2, mode="async")
+    svc.init_param("w", np.zeros(2, np.float32), {"type": "sgd", "lr": 1.0})
+    svc.finish_init_params()
+    svc.send_grad("t0", {"w": np.array([1.0, 0.0], np.float32)})
+    np.testing.assert_allclose(svc.get_param("w"), [-1.0, 0.0])
+
+
+def test_sparse_rows_update_and_prefetch():
+    svc = ParameterServerService(num_trainers=1)
+    table = np.zeros((10, 4), np.float32)
+    svc.init_param("emb", table, {"type": "adagrad", "lr": 1.0})
+    svc.finish_init_params()
+    rows = np.array([2, 7, 2])
+    vals = np.ones((3, 4), np.float32)
+    svc.send_sparse_grad("t0", "emb", rows, vals)
+    got = svc.get_param("emb")
+    # untouched rows stay exactly zero
+    assert np.all(got[[0, 1, 3, 4, 5, 6, 8, 9]] == 0)
+    assert np.all(got[2] != 0) and np.all(got[7] != 0)
+    # sparse prefetch returns only requested rows
+    sub = svc.get_param_rows("emb", np.array([2, 7]))
+    np.testing.assert_allclose(sub, got[[2, 7]])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    d = str(tmp_path)
+    svc = ParameterServerService(num_trainers=1, checkpoint_dir=d)
+    svc.init_param("w", np.ones(4, np.float32), {"type": "adam", "lr": 0.1})
+    svc.finish_init_params()
+    svc.send_grad("t0", {"w": np.ones(4, np.float32)})
+    expect = svc.get_param("w")
+    svc.save_checkpoint()
+
+    svc2 = ParameterServerService(num_trainers=1, checkpoint_dir=d)
+    assert svc2.load_checkpoint()
+    np.testing.assert_allclose(svc2.get_param("w"), expect)
+    assert svc2.initialized()
+    # adam optimizer state survived the round-trip exactly
+    src = svc._opts["w"]
+    dst = svc2._opts["w"]
+    assert dst.t == src.t == 1
+    np.testing.assert_allclose(dst.m, src.m)
+    np.testing.assert_allclose(dst.v, src.v)
+
+
+def test_tcp_two_servers_two_trainers(tmp_path):
+    """End-to-end over loopback TCP: 2 pservers (name-hash split), 2 BSP
+    trainers (the in-process fake cluster — reference
+    send_recv_op_test.cc / test_CompareSparse style)."""
+    s1 = PServer(num_trainers=2).start()
+    s2 = PServer(num_trainers=2).start()
+    eps = [s1.endpoint, s2.endpoint]
+    try:
+        c0 = ParameterClient(eps, trainer_id="0")
+        c1 = ParameterClient(eps, trainer_id="1")
+        # trainer 0 seeds params (cclient.go: only trainer 0 inits)
+        c0.init_param("w1", np.zeros(3, np.float32),
+                      {"type": "sgd", "lr": 1.0})
+        c0.init_param("w2", np.zeros(2, np.float32),
+                      {"type": "sgd", "lr": 1.0})
+        c0.finish_init_params()
+        assert c0.initialized()
+
+        g = {"w1": np.ones(3, np.float32), "w2": np.ones(2, np.float32)}
+        t = threading.Thread(target=c1.send_grads, args=(g,))
+        t.start()
+        c0.send_grads(g)
+        t.join(timeout=20)
+        assert not t.is_alive()
+
+        params = c0.get_params()
+        np.testing.assert_allclose(params["w1"], -np.ones(3))
+        np.testing.assert_allclose(params["w2"], -np.ones(2))
+
+        # sparse path over the wire
+        c0.init_param  # (already initialized; just exercise sparse RPC)
+        c0.send_sparse_grad("w1", np.array([0]),
+                            np.array([[2.0]], np.float32).reshape(1))
+        assert c0.get_param("w1")[0] == pytest.approx(-3.0)
+        np.testing.assert_allclose(
+            c0.get_param_rows("w1", np.array([1])), [-1.0])
+
+        # pass barrier rendezvous
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(c1.pass_barrier()))
+        t.start()
+        results.append(c0.pass_barrier())
+        t.join(timeout=20)
+        assert results[0] == results[1] == 1
+        c0.close()
+        c1.close()
+    finally:
+        s1.stop()
+        s2.stop()
