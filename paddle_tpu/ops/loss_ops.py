@@ -191,3 +191,114 @@ def precision_recall(ctx, ins, attrs):
     f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
     macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
     return {"BatchMetrics": [macro], "AccumMetrics": [macro]}
+
+
+def _chunk_markers(labels, lengths, num_chunk_types, scheme):
+    """Per-position chunk start/end/type/in-chunk markers for a [B,T] int tag
+    sequence under a CoNLL tagging scheme (reference chunk_eval_op.h's
+    Segment extraction, vectorized over the padded batch)."""
+    import jax.numpy as jnp
+
+    num_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    o_label = num_chunk_types * num_tag
+    T = labels.shape[1]
+    valid = (jnp.arange(T)[None, :] < lengths[:, None]) & (labels < o_label)
+    ctype = jnp.where(valid, labels // num_tag, -1)
+    tag = jnp.where(valid, labels % num_tag, -1)
+    prev_t = jnp.pad(ctype, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    next_t = jnp.pad(ctype, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    prev_tag = jnp.pad(tag, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    next_tag = jnp.pad(tag, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    diff_prev = (prev_t != ctype)
+    diff_next = (next_t != ctype)
+    if scheme == "plain":
+        start, end = diff_prev, diff_next
+    elif scheme == "IOB":  # B=0 I=1
+        start = (tag == 0) | ((tag == 1) & diff_prev)
+        end = diff_next | (next_tag == 0)
+    elif scheme == "IOE":  # I=0 E=1
+        start = diff_prev | (prev_tag == 1)
+        end = (tag == 1) | ((tag == 0) & diff_next)
+    else:  # IOBES: B=0 I=1 E=2 S=3
+        start = (tag == 0) | (tag == 3) | ((tag != -1) & diff_prev)
+        end = (tag == 2) | (tag == 3) | ((tag != -1) & diff_next)
+    start = start & valid
+    end = end & valid
+    return start, end, ctype, valid
+
+
+@register_op("chunk_eval", grad=None, non_diff_inputs=("Inference", "Label",
+                                                       "Length"))
+def chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 (reference chunk_eval_op.cc; feeds the
+    ChunkEvaluator).  A predicted chunk is correct iff a label chunk has the
+    same [start, end] span and type — counted with one scan over time."""
+    import jax
+    import jax.numpy as jnp
+
+    inf = ins["Inference"][0].astype(jnp.int32)
+    lab = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim > 2:
+        inf = inf.reshape(inf.shape[0], -1)
+        lab = lab.reshape(lab.shape[0], -1)
+    lengths = (ins["Length"][0].astype(jnp.int32) if ins.get("Length")
+               and ins["Length"][0] is not None
+               else jnp.full((inf.shape[0],), inf.shape[1], jnp.int32))
+    ncls = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+
+    i_start, i_end, i_type, _ = _chunk_markers(inf, lengths, ncls, scheme)
+    l_start, l_end, l_type, _ = _chunk_markers(lab, lengths, ncls, scheme)
+    n_inf = jnp.sum(i_start)
+    n_lab = jnp.sum(l_start)
+
+    # scan: `open` = inside chunks that started together, same type, and have
+    # stayed span-identical; a simultaneous end while open is a correct chunk
+    def step(open_, t):
+        both_start = i_start[:, t] & l_start[:, t] & (i_type[:, t] == l_type[:, t])
+        open_ = jnp.where(i_start[:, t] | l_start[:, t], both_start, open_)
+        open_ = open_ & (i_type[:, t] == l_type[:, t])
+        both_end = i_end[:, t] & l_end[:, t]
+        any_end = i_end[:, t] | l_end[:, t]
+        correct = open_ & both_end
+        open_ = open_ & ~any_end
+        return open_, jnp.sum(correct)
+
+    B, T = inf.shape
+    _, per_t = jax.lax.scan(step, jnp.zeros((B,), bool), jnp.arange(T))
+    n_correct = jnp.sum(per_t)
+    prec = n_correct / jnp.maximum(n_inf, 1)
+    rec = n_correct / jnp.maximum(n_lab, 1)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    i64 = lambda v: v.astype(jnp.int64).reshape((1,))
+    f32 = lambda v: v.astype(jnp.float32).reshape((1,))
+    return {"Precision": [f32(prec)], "Recall": [f32(rec)],
+            "F1-Score": [f32(f1)], "NumInferChunks": [i64(n_inf)],
+            "NumLabelChunks": [i64(n_lab)],
+            "NumCorrectChunks": [i64(n_correct)]}
+
+
+@register_op("positive_negative_pair", grad=None)
+def positive_negative_pair(ctx, ins, attrs):
+    """Ranking pair statistics per query (reference
+    positive_negative_pair_op.cc): among same-query pairs with different
+    labels, count concordant / discordant / tied-score pairs."""
+    import jax.numpy as jnp
+
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones((score.shape[0],) * 2, bool), k=1)
+    informative = same_q & upper & (label[:, None] != label[None, :])
+    ds = score[:, None] - score[None, :]
+    dl = label[:, None] - label[None, :]
+    pos = jnp.sum((informative & (ds * dl > 0)).astype(jnp.float32))
+    neg = jnp.sum((informative & (ds * dl < 0)).astype(jnp.float32))
+    neu = jnp.sum((informative & (ds == 0)).astype(jnp.float32))
+    acc = lambda slot, v: (v + ins[slot][0].reshape(-1)[0]
+                           if ins.get(slot) and ins[slot][0] is not None else v)
+    r = lambda v: v.reshape((1,))
+    return {"PositivePair": [r(acc("AccumulatePositivePair", pos))],
+            "NegativePair": [r(acc("AccumulateNegativePair", neg))],
+            "NeutralPair": [r(acc("AccumulateNeutralPair", neu))]}

@@ -183,6 +183,24 @@ def clip_by_norm(ctx, ins, attrs):
     return {"Out": [jnp.where(norm > mn, x * (mn / norm), x)]}
 
 
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    """L2-normalize along `axis` (reference norm_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 1)) % x.ndim
+    eps = float(attrs.get("epsilon", 1e-10))
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    out = x / n
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        # per-channel learned scale (the SSD normalize layer form)
+        s = ins["Scale"][0].reshape([-1 if i == axis else 1
+                                     for i in range(x.ndim)])
+        out = out * s
+    return {"Out": [out], "Norm": [n]}
+
+
 @register_op("l1_norm")
 def l1_norm(ctx, ins, attrs):
     jnp = _j()

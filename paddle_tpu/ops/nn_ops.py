@@ -218,6 +218,109 @@ def im2sequence(ctx, ins, attrs):
     return {"Out": [patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ck)]}
 
 
+@register_op("max_pool2d_with_index", non_diff_outputs=("Mask",))
+def max_pool2d_with_index(ctx, ins, attrs):
+    """Max pool that also returns the flat h*W+w argmax per window
+    (reference pool_with_index_op.cc) — the index input of `unpool`."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    N, C, H, W = x.shape
+    pad_cfg = [(pads[0], pads[0]), (pads[1], pads[1])]
+    neg = jnp.finfo(x.dtype).min
+
+    def patches(a, fill):
+        a = jnp.pad(a, ((0, 0), (0, 0), pad_cfg[0], pad_cfg[1]),
+                    constant_values=fill)
+        p = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ksize, window_strides=strides,
+            padding=[(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, _, oh, ow = p.shape
+        return p.reshape(n, a.shape[1], ksize[0] * ksize[1], oh, ow)
+
+    # flat output-space index of every input pixel, broadcast over N and C.
+    # Indices ride through the float patch extractor in float32 (exact up to
+    # 2^24) — never in x.dtype, which may be bfloat16
+    flat = (jnp.arange(H)[:, None] * W
+            + jnp.arange(W)[None, :]).astype(jnp.float32)
+    xp = patches(x, neg)
+    ip = patches(jnp.broadcast_to(flat, (N, C, H, W)), -1.0)
+    arg = jnp.argmax(xp, axis=2)
+    out = jnp.max(xp, axis=2)
+    idx = jnp.take_along_axis(ip, arg[:, :, None], axis=2)[:, :, 0]
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("unpool", non_diff_inputs=("Indices",))
+def unpool(ctx, ins, attrs):
+    """Max unpooling (reference unpool_op.cc): scatter each pooled value back
+    to the position its `max_pool2d_with_index` Mask recorded."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [N, C, h, w]
+    idx = ins["Indices"][0]  # flat H*W positions, same shape
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    N, C, h, w = x.shape
+    if attrs.get("output_size"):
+        OH, OW = _pair(attrs["output_size"])
+    else:
+        OH, OW = (h - 1) * strides[0] + ksize[0], (w - 1) * strides[1] + ksize[1]
+    vals = x.reshape(N * C, h * w)
+    flat_idx = idx.reshape(N * C, h * w).astype(jnp.int32)
+    out = jnp.zeros((N * C, OH * OW), x.dtype)
+    out = out.at[jnp.arange(N * C)[:, None], flat_idx].set(vals)
+    return {"Out": [out.reshape(N, C, OH, OW)]}
+
+
+@register_op("spp")
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference spp_op.cc): pyramid_height levels of
+    adaptive 2**l x 2**l pooling, flattened + concatenated — fixed-length
+    output for any input HxW."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCHW
+    levels = int(attrs.get("pyramid_height", 2))
+    ptype = attrs.get("pooling_type", "max").lower()
+    N, C, H, W = x.shape
+    outs = []
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        rows = []
+        for bi in range(bins):
+            h0, h1 = (bi * H) // bins, max(((bi + 1) * H + bins - 1) // bins, (bi * H) // bins + 1)
+            cols = []
+            for bj in range(bins):
+                w0, w1 = (bj * W) // bins, max(((bj + 1) * W + bins - 1) // bins, (bj * W) // bins + 1)
+                cell = x[:, :, h0:h1, w0:w1]
+                if ptype == "max":
+                    cols.append(jnp.max(cell, axis=(2, 3)))
+                else:
+                    cols.append(jnp.mean(cell, axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        outs.append(jnp.stack(rows, axis=-2).reshape(N, C * bins * bins))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("conv_shift")
+def conv_shift(ctx, ins, attrs):
+    """Circular convolution (reference conv_shift_op.cc, NTM attention-shift):
+    Out[b,i] = sum_j X[b,(i+j-N//2) mod M] * Y[b,j], Y width N odd, N<=M."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]  # [B, M], [B, N]
+    n = y.shape[1]
+    half = n // 2
+    out = sum(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1] for j in range(n))
+    return {"Out": [out]}
+
+
 @register_op("bilinear_tensor_product")
 def bilinear_tensor_product(ctx, ins, attrs):
     import jax.numpy as jnp

@@ -338,3 +338,60 @@ def gru_unit(ctx, ins, attrs):
     c = jnp.tanh(x[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
     h = u * h_prev + (1 - u) * c
     return {"Hidden": [h], "Gate": [g], "ResetHiddenPrev": [r * h_prev]}
+
+
+@register_op("sequence_slice", non_diff_inputs=("Offset", "SliceLength",
+                                                "Length"))
+def sequence_slice(ctx, ins, attrs):
+    """Per-sequence sub-window (reference sequence_slice_op.cc): take
+    SliceLength[b] steps starting at Offset[b] from each padded row; the time
+    axis keeps its static extent, tail masked to 0."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [B, T, ...]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    slen = ins["SliceLength"][0].reshape(-1).astype(jnp.int32)
+    T = x.shape[1]
+    idx = off[:, None] + jnp.arange(T)[None, :]
+    idx = jnp.clip(idx, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    m = _mask(slen, T, x.dtype)
+    while m.ndim < out.ndim:
+        m = m[..., None]
+    return {"Out": [out * m], "LengthOut": [slen]}
+
+
+@register_op("sequence_reshape", non_diff_inputs=("Length",))
+def sequence_reshape(ctx, ins, attrs):
+    """Re-chunk each sequence's payload to `new_dim` features (reference
+    sequence_reshape_op.cc): row b holds len[b]*D contiguous values, so a
+    per-row reshape preserves them; new length = len*D/new_dim."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [B, T, D]
+    lengths = ins["Length"][0]
+    new_dim = int(attrs["new_dim"])
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0, "new_dim must divide T*D"
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    # ceil division: a row whose len*D isn't a new_dim multiple keeps its
+    # trailing values in a final partially-padded step (the reference errors
+    # on that case; static shapes can't, so keep the payload instead)
+    new_len = -(-(lengths * D) // new_dim)
+    return {"Out": [out], "LengthOut": [new_len.astype(jnp.int32)]}
+
+
+@register_op("lod_reset", grad=None, non_diff_inputs=("Y", "Length"))
+def lod_reset(ctx, ins, attrs):
+    """Replace a tensor's sequence segmentation (reference lod_reset_op.cc).
+    In the padded representation the payload is untouched and only the
+    companion lengths change — from input Y's lengths or attr target_lengths."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    if ins.get("Y") and ins["Y"][0] is not None:
+        new_len = ins["Y"][0].reshape(-1).astype(jnp.int32)
+    else:
+        new_len = jnp.asarray(attrs["target_lengths"], dtype=jnp.int32)
+    return {"Out": [x], "LengthOut": [new_len]}

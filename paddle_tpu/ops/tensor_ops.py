@@ -274,6 +274,34 @@ def lookup_table(ctx, ins, attrs):
     return {"Out": [emb.reshape(out_shape)]}
 
 
+@register_op("assign_value", grad=None)
+def assign_value(ctx, ins, attrs):
+    """Materialize attr-carried constants (reference assign_value_op.cc)."""
+    import jax.numpy as jnp
+
+    shape = [int(s) for s in attrs["shape"]]
+    if "fp32_values" in attrs and attrs["fp32_values"]:
+        vals = jnp.asarray(attrs["fp32_values"], dtype=jnp.float32)
+    else:
+        vals = jnp.asarray(attrs["int32_values"], dtype=jnp.int32)
+    return {"Out": [vals.reshape(shape)]}
+
+
+@register_op("print")
+def print_op(ctx, ins, attrs):
+    """Debug print (reference print_op.cc): identity passthrough that prints
+    the tensor at runtime from inside the compiled program."""
+    import jax
+
+    x = ins["X"][0]
+    msg = attrs.get("message", "")
+    phase = attrs.get("print_phase", "forward")
+    if phase != "none":
+        safe = msg.replace("{", "{{").replace("}", "}}")
+        jax.debug.print(safe + "{x}", x=x)
+    return {"Out": [x]}
+
+
 @register_op("increment")
 def increment(ctx, ins, attrs):
     return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}

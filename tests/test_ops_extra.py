@@ -1,0 +1,184 @@
+"""Op inventory gap-fill tests: pooling-with-index/unpool, spp, conv_shift,
+norm, chunk_eval, positive_negative_pair, assign_value, sequence
+slice/reshape/lod_reset (reference test_{pool_max,unpool,spp,conv_shift,norm,
+chunk_eval,positive_negative_pair}_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RNG = np.random.RandomState(11)
+
+
+def _r(*shape):
+    return RNG.uniform(0.1, 1.0, shape).astype(np.float64)
+
+
+def test_max_pool2d_with_index():
+    x = _r(2, 3, 4, 4)
+    t = OpTestHarness("max_pool2d_with_index", {"X": x},
+                      {"ksize": [2, 2], "strides": [2, 2]},
+                      out_slots=["Out", "Mask"])
+    want = np.zeros((2, 3, 2, 2))
+    mask = np.zeros((2, 3, 2, 2), np.int32)
+    for n in range(2):
+        for c in range(3):
+            for i in range(2):
+                for j in range(2):
+                    win = x[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    want[n, c, i, j] = win.max()
+                    a = np.unravel_index(win.argmax(), (2, 2))
+                    mask[n, c, i, j] = (2 * i + a[0]) * 4 + (2 * j + a[1])
+    t.check_output({"Out": want, "Mask": mask})
+
+
+def test_unpool_roundtrip():
+    # pool 4x4 -> 2x2, then unpool back: max values land at recorded spots
+    x = _r(1, 2, 4, 4)
+    pooled = np.zeros((1, 2, 2, 2))
+    idx = np.zeros((1, 2, 2, 2), np.int32)
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                win = x[0, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                pooled[0, c, i, j] = win.max()
+                a = np.unravel_index(win.argmax(), (2, 2))
+                idx[0, c, i, j] = (2 * i + a[0]) * 4 + (2 * j + a[1])
+    t = OpTestHarness("unpool", {"X": pooled, "Indices": idx},
+                      {"ksize": [2, 2], "strides": [2, 2],
+                       "output_size": [4, 4]})
+    want = np.zeros_like(x)
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                f = idx[0, c, i, j]
+                want[0, c, f // 4, f % 4] = pooled[0, c, i, j]
+    t.check_output({"Out": want})
+    t.check_grad(["X"])
+
+
+def test_spp_shapes_and_level0():
+    x = _r(2, 3, 6, 6)
+    t = OpTestHarness("spp", {"X": x}, {"pyramid_height": 2,
+                                        "pooling_type": "max"})
+    lvl0 = x.max(axis=(2, 3))  # [2, 3]
+    lvl1 = np.stack([x[:, :, 3 * i:3 * i + 3, 3 * j:3 * j + 3].max(axis=(2, 3))
+                     for i in range(2) for j in range(2)],
+                    axis=-1).reshape(2, 12)
+    t.check_output({"Out": np.concatenate([lvl0, lvl1], axis=1)})
+    t.check_grad(["X"])
+
+
+def test_conv_shift():
+    x, y = _r(3, 7), _r(3, 3)
+    t = OpTestHarness("conv_shift", {"X": x, "Y": y})
+    M, N = 7, 3
+    want = np.zeros_like(x)
+    for b in range(3):
+        for i in range(M):
+            want[b, i] = sum(x[b, (i + j - N // 2) % M] * y[b, j]
+                             for j in range(N))
+    t.check_output({"Out": want})
+    t.check_grad(["X", "Y"])
+
+
+def test_norm_op():
+    x = _r(3, 5, 2)
+    t = OpTestHarness("norm", {"X": x}, {"axis": 1, "epsilon": 1e-10},
+                      out_slots=["Out", "Norm"])
+    n = np.sqrt((x * x).sum(axis=1, keepdims=True) + 1e-10)
+    t.check_output({"Out": x / n})
+    t.check_grad(["X"])
+
+
+def test_chunk_eval_iob():
+    # 2 chunk types, IOB: B0=0 I0=1 B1=2 I1=3 O=4
+    label = np.array([[0, 1, 4, 2, 3],
+                      [2, 4, 0, 1, 1]], np.int64)
+    inf = np.array([[0, 1, 4, 2, 4],     # 2nd chunk truncated → wrong span
+                    [2, 4, 0, 1, 1]], np.int64)  # both exact
+    lengths = np.array([5, 5], np.int64)
+    t = OpTestHarness(
+        "chunk_eval", {"Inference": inf, "Label": label, "Length": lengths},
+        {"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        out_slots=["Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"])
+    # label chunks: r0: [0-1]t0, [3-4]t1; r1: [0]t1, [2-4]t0  → 4
+    # infer chunks: r0: [0-1]t0, [3]t1;  r1: [0]t1, [2-4]t0   → 4, correct 3
+    t.check_output({"NumLabelChunks": [4], "NumInferChunks": [4],
+                    "NumCorrectChunks": [3],
+                    "Precision": [0.75], "Recall": [0.75]})
+
+
+def test_chunk_eval_plain():
+    # plain scheme: label = chunk type directly, O = num_chunk_types
+    label = np.array([[0, 0, 2, 1, 1]], np.int64)
+    inf = np.array([[0, 0, 2, 1, 0]], np.int64)
+    t = OpTestHarness(
+        "chunk_eval", {"Inference": inf, "Label": label,
+                       "Length": np.array([5], np.int64)},
+        {"num_chunk_types": 2, "chunk_scheme": "plain"},
+        out_slots=["NumInferChunks", "NumLabelChunks", "NumCorrectChunks"])
+    # label: [0-1]t0, [3-4]t1 → 2; infer: [0-1]t0, [3]t1, [4]t0 → 3; correct 1
+    t.check_output({"NumLabelChunks": [2], "NumInferChunks": [3],
+                    "NumCorrectChunks": [1]})
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.2], [0.5], [0.5]], np.float64)
+    label = np.array([[1], [0], [1], [0]], np.float64)
+    qid = np.array([[0], [0], [1], [1]], np.int64)
+    t = OpTestHarness(
+        "positive_negative_pair",
+        {"Score": score, "Label": label, "QueryID": qid},
+        out_slots=["PositivePair", "NegativePair", "NeutralPair"])
+    # q0: (0.9,1)v(0.2,0) → positive; q1: scores tie → neutral
+    t.check_output({"PositivePair": [1.0], "NegativePair": [0.0],
+                    "NeutralPair": [1.0]})
+
+
+def test_assign_value():
+    t = OpTestHarness("assign_value", {},
+                      {"shape": [2, 3],
+                       "fp32_values": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]})
+    t.check_output({"Out": np.arange(1.0, 7.0).reshape(2, 3)})
+
+
+def test_sequence_slice():
+    x = _r(2, 5, 3)
+    off = np.array([1, 0], np.int64)
+    slen = np.array([2, 3], np.int64)
+    t = OpTestHarness(
+        "sequence_slice",
+        {"X": x, "Offset": off, "SliceLength": slen,
+         "Length": np.array([5, 5], np.int64)},
+        out_slots=["Out", "LengthOut"])
+    want = np.zeros_like(x)
+    want[0, :2] = x[0, 1:3]
+    want[1, :3] = x[1, 0:3]
+    t.check_output({"Out": want, "LengthOut": slen})
+    t.check_grad(["X"])
+
+
+def test_sequence_reshape():
+    x = _r(2, 4, 6)
+    lengths = np.array([4, 2], np.int64)
+    t = OpTestHarness("sequence_reshape", {"X": x, "Length": lengths},
+                      {"new_dim": 3}, out_slots=["Out", "LengthOut"])
+    t.check_output({"Out": x.reshape(2, 8, 3), "LengthOut": [8, 4]})
+
+
+def test_lod_reset():
+    x = _r(2, 4)
+    t = OpTestHarness("lod_reset",
+                      {"X": x, "Length": np.array([4, 4], np.int64)},
+                      {"target_lengths": [2, 3]},
+                      out_slots=["Out", "LengthOut"])
+    t.check_output({"Out": x, "LengthOut": [2, 3]})
+
+
+def test_print_op_identity(capfd):
+    x = _r(2, 2)
+    t = OpTestHarness("print", {"X": x}, {"message": "dbg: "})
+    t.check_output({"Out": x})
