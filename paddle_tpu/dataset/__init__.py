@@ -9,10 +9,15 @@ schema (shapes, dtypes, vocab conventions) so pipelines and book tests run
 anywhere."""
 
 from . import cifar  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
 from . import imdb  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import mnist  # noqa: F401
 from . import movielens  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import sentiment  # noqa: F401
 from . import uci_housing  # noqa: F401
+from . import voc2012  # noqa: F401
 from . import wmt14  # noqa: F401
 from .common import DATA_HOME  # noqa: F401

@@ -1,0 +1,75 @@
+"""CoNLL-2005 semantic role labeling (reference v2/dataset/conll05.py).
+
+Each sample is the reference's 9-slot layout (conll05.py reader_creator):
+word sequence, five predicate-context windows (ctx_n2..ctx_p2), predicate
+id sequence, mark sequence (1 on predicate span), and IOB role labels."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+WORD_DICT_LEN = 44068   # reference conll05 word dict size
+LABEL_DICT_LEN = 59     # 29 role types x (B,I) + O
+PRED_DICT_LEN = 3162
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
+    verb_dict = {f"v{i}": i for i in range(PRED_DICT_LEN)}
+    label_dict = {f"l{i}": i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Pretrained word embedding table surrogate (reference ships emb.tar)."""
+    if has_cached("conll05", "emb.pkl"):
+        return load_cached("conll05", "emb.pkl")
+    rng = synthetic_rng("conll05_emb")
+    return rng.uniform(-1, 1, (WORD_DICT_LEN, 32)).astype(np.float32)
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("conll05", seed)
+    out = []
+    for _ in range(n):
+        ln = int(rng.randint(5, 30))
+        words = rng.randint(0, WORD_DICT_LEN, ln).astype(np.int64)
+        pred_pos = int(rng.randint(0, ln))
+        pred = np.full(ln, rng.randint(0, PRED_DICT_LEN), np.int64)
+        mark = np.zeros(ln, np.int64)
+        mark[pred_pos] = 1
+
+        def ctx(off):
+            idx = np.clip(np.full(ln, pred_pos + off), 0, ln - 1)
+            return words[idx]
+
+        # IOB labels: O everywhere, one argument span around the predicate
+        labels = np.full(ln, LABEL_DICT_LEN - 1, np.int64)
+        span = int(rng.randint(1, 4))
+        start = max(0, pred_pos - span)
+        role = int(rng.randint(0, (LABEL_DICT_LEN - 1) // 2))
+        labels[start] = 2 * role
+        labels[start + 1:pred_pos + 1] = 2 * role + 1
+        out.append((words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                    pred, mark, labels))
+    return out
+
+
+def _reader(n, seed, fname):
+    def reader():
+        data = (load_cached("conll05", fname)
+                if has_cached("conll05", fname) else _synthetic(n, seed))
+        for sample in data:
+            yield sample
+
+    return reader
+
+
+def test(n=512):
+    return _reader(n, 1, "test.pkl")
+
+
+def train(n=2048):
+    return _reader(n, 0, "train.pkl")

@@ -1,0 +1,44 @@
+"""Oxford 102 flowers (reference v2/dataset/flowers.py): 3x224x224 float32
+CHW images in [0,1] + one of 102 labels."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+NUM_CLASSES = 102
+IMG_SHAPE = (3, 224, 224)
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("flowers", seed)
+    for _ in range(n):
+        label = int(rng.randint(0, NUM_CLASSES))
+        # class-correlated mean so a classifier can actually learn
+        img = rng.normal(label / NUM_CLASSES, 0.2,
+                         IMG_SHAPE).astype(np.float32)
+        yield np.clip(img, 0.0, 1.0), label
+
+
+def _reader(n, seed, fname):
+    def reader():
+        if has_cached("flowers", fname):
+            for sample in load_cached("flowers", fname):
+                yield sample
+        else:
+            yield from _synthetic(n, seed)
+
+    return reader
+
+
+def train(n=256, mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(n, 0, "train.pkl")
+
+
+def valid(n=64, mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(n, 1, "valid.pkl")
+
+
+def test(n=64, mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(n, 2, "test.pkl")

@@ -1,0 +1,64 @@
+"""LETOR MQ2007 learning-to-rank (reference v2/dataset/mq2007.py).
+
+Three reader formats, as in the reference:
+- ``pointwise``: (feature [46], relevance score)
+- ``pairwise``: (higher-ranked feature, lower-ranked feature)
+- ``listwise``: (label list, feature list) per query
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+FEATURE_DIM = 46
+MAX_REL = 2  # relevance grades 0..2
+
+
+def _synthetic_queries(n_queries, seed):
+    rng = synthetic_rng("mq2007", seed)
+    queries = []
+    for _ in range(n_queries):
+        n_docs = int(rng.randint(4, 12))
+        labels = rng.randint(0, MAX_REL + 1, n_docs)
+        # relevance-correlated features so rankers can learn
+        feats = (rng.normal(0, 0.3, (n_docs, FEATURE_DIM))
+                 + labels[:, None] * 0.5).astype(np.float32)
+        queries.append((labels.astype(np.int64), feats))
+    return queries
+
+
+def _load(n_queries, seed, fname):
+    if has_cached("mq2007", fname):
+        return load_cached("mq2007", fname)
+    return _synthetic_queries(n_queries, seed)
+
+
+def _reader(format, n_queries, seed, fname):
+    def pointwise():
+        for labels, feats in _load(n_queries, seed, fname):
+            for y, x in zip(labels, feats):
+                yield x, int(y)
+
+    def pairwise():
+        for labels, feats in _load(n_queries, seed, fname):
+            for i in range(len(labels)):
+                for j in range(len(labels)):
+                    if labels[i] > labels[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for labels, feats in _load(n_queries, seed, fname):
+            yield list(labels), list(feats)
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format="pairwise", n_queries=120):
+    return _reader(format, n_queries, 0, "train.pkl")
+
+
+def test(format="pairwise", n_queries=30):
+    return _reader(format, n_queries, 1, "test.pkl")

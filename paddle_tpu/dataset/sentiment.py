@@ -1,0 +1,43 @@
+"""NLTK movie-review sentiment (reference v2/dataset/sentiment.py):
+(token-id sequence, 0/1 polarity)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+WORD_DICT_LEN = 8192
+
+
+def get_word_dict():
+    """word → id, sorted by frequency (reference sentiment.py get_word_dict)."""
+    return {f"w{i}": i for i in range(WORD_DICT_LEN)}
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("sentiment", seed)
+    for _ in range(n):
+        ln = int(rng.randint(6, 48))
+        label = int(rng.randint(0, 2))
+        toks = rng.randint(0, WORD_DICT_LEN // 2, ln) * 2 + label
+        yield np.minimum(toks, WORD_DICT_LEN - 1).astype(np.int64), label
+
+
+def _reader(n, seed, fname):
+    def reader():
+        if has_cached("sentiment", fname):
+            for sample in load_cached("sentiment", fname):
+                yield sample
+        else:
+            yield from _synthetic(n, seed)
+
+    return reader
+
+
+def train(n=1600):
+    return _reader(n, 0, "train.pkl")
+
+
+def test(n=400):
+    return _reader(n, 1, "test.pkl")

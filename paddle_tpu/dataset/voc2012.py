@@ -1,0 +1,52 @@
+"""PASCAL VOC2012 segmentation (reference v2/dataset/voc2012.py): (image
+3xHxW float32, label map HxW int32 with 0..20 classes + 255 ignore)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import has_cached, load_cached, synthetic_rng
+
+NUM_CLASSES = 21
+IGNORE_LABEL = 255
+IMG_HW = (128, 128)  # synthetic surrogate resolution
+
+
+def _synthetic(n, seed):
+    rng = synthetic_rng("voc2012", seed)
+    H, W = IMG_HW
+    for _ in range(n):
+        img = rng.uniform(0, 1, (3, H, W)).astype(np.float32)
+        label = np.zeros((H, W), np.int32)
+        # one rectangular object per image
+        cls = int(rng.randint(1, NUM_CLASSES))
+        y0, x0 = rng.randint(0, H // 2), rng.randint(0, W // 2)
+        y1, x1 = y0 + rng.randint(8, H // 2), x0 + rng.randint(8, W // 2)
+        label[y0:y1, x0:x1] = cls
+        img[:, y0:y1, x0:x1] += cls / NUM_CLASSES  # signal for learning
+        # thin ignore border around the object, as in real VOC masks
+        label[y0, x0:x1] = IGNORE_LABEL
+        yield np.clip(img, 0, 2), label
+
+
+def _reader(n, seed, fname):
+    def reader():
+        if has_cached("voc2012", fname):
+            for sample in load_cached("voc2012", fname):
+                yield sample
+        else:
+            yield from _synthetic(n, seed)
+
+    return reader
+
+
+def train(n=128):
+    return _reader(n, 0, "train.pkl")
+
+
+def val(n=32):
+    return _reader(n, 1, "val.pkl")
+
+
+def test(n=32):
+    return _reader(n, 2, "test.pkl")

@@ -1,0 +1,158 @@
+"""Memory accounting + host staging (reference paddle/memory/, SURVEY.md §2.4).
+
+The reference exposes ``memory::Alloc/Free/Used<Place>`` over a per-device
+buddy allocator (memory/detail/buddy_allocator.h:33).  On TPU the actual HBM
+allocator is XLA/PJRT's — a hand-rolled buddy allocator would fight it, not
+help it — so the capability surface kept here is the *accounting* contract:
+
+- ``used/total/available(place)`` — live HBM/host byte counts, from PJRT
+  ``memory_stats()`` where the backend reports them, else from a process-side
+  ledger of arrays handed out by :func:`alloc`.
+- ``memory_stats(place)`` — the raw stats dict (peak, limit, ...).
+- ``Copy`` / :class:`HostStaging` — the memcpy.h equivalent: explicit
+  host↔device transfers and a reusable pinned-style staging buffer pool for
+  feed/fetch (reference memory/memcpy.cc, CPUAllocator pinned path).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Optional
+
+import numpy as np
+
+from .framework.place import CPUPlace, Place, TPUPlace, default_place
+
+_lock = threading.Lock()
+# place-key → ledger of bytes handed out via alloc() (fallback accounting for
+# backends that do not implement PJRT memory_stats, e.g. XLA:CPU)
+_ledger: Dict[str, int] = {}
+_peak: Dict[str, int] = {}
+# id(array) → weakref.finalize decrementing the ledger; fires on GC or on an
+# explicit free(), whichever comes first (finalize guards double-run), and
+# removes its own entry so recycled ids can't hit stale bookkeeping
+_finalizers: Dict[int, object] = {}
+
+
+def _dec(key: str, nbytes: int, ident: int) -> None:
+    with _lock:
+        _ledger[key] = max(_ledger.get(key, 0) - nbytes, 0)
+        _finalizers.pop(ident, None)
+
+
+def _key(place: Place) -> str:
+    return repr(place)
+
+
+def _jax_device(place: Optional[Place]):
+    place = place if place is not None else default_place()
+    return place, place.jax_device()
+
+
+def memory_stats(place: Optional[Place] = None) -> dict:
+    """Raw PJRT memory stats for the place's device ({} if unsupported)."""
+    _, dev = _jax_device(place)
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    return dict(stats) if stats else {}
+
+
+def used(place: Optional[Place] = None) -> int:
+    """Bytes currently in use on `place` (memory::Used equivalent)."""
+    place, _ = _jax_device(place)
+    stats = memory_stats(place)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    with _lock:
+        return _ledger.get(_key(place), 0)
+
+
+def peak(place: Optional[Place] = None) -> int:
+    place, _ = _jax_device(place)
+    stats = memory_stats(place)
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    with _lock:
+        return _peak.get(_key(place), 0)
+
+
+def total(place: Optional[Place] = None) -> int:
+    """Byte capacity of the place (HBM size; 0 if the backend hides it)."""
+    place, _ = _jax_device(place)
+    stats = memory_stats(place)
+    for k in ("bytes_limit", "bytes_reservable_limit"):
+        if k in stats:
+            return int(stats[k])
+    return 0
+
+
+def available(place: Optional[Place] = None) -> int:
+    t = total(place)
+    return max(t - used(place), 0) if t else 0
+
+
+def alloc(shape, dtype="float32", place: Optional[Place] = None):
+    """Allocate a zeroed device buffer and account for it (memory::Alloc).
+
+    Returns a jax.Array committed to `place`; pair with :func:`free` to keep
+    the fallback ledger accurate on backends without memory_stats."""
+    import jax
+    import jax.numpy as jnp
+
+    place, dev = _jax_device(place)
+    arr = jax.device_put(jnp.zeros(shape, dtype=dtype), dev)
+    nbytes = int(np.dtype(arr.dtype).itemsize * int(np.prod(arr.shape)))
+    with _lock:
+        k = _key(place)
+        _ledger[k] = _ledger.get(k, 0) + nbytes
+        _peak[k] = max(_peak.get(k, 0), _ledger[k])
+        _finalizers[id(arr)] = weakref.finalize(arr, _dec, k, nbytes, id(arr))
+    return arr
+
+
+def free(arr) -> None:
+    """Release a buffer obtained from :func:`alloc` (memory::Free); arrays
+    dropped without free() are reclaimed by their GC finalizer."""
+    fin = _finalizers.get(id(arr))
+    if fin is not None:
+        fin()
+    try:
+        arr.delete()
+    except Exception:
+        pass
+
+
+def Copy(dst_place: Place, src, src_place: Optional[Place] = None):
+    """Explicit cross-place copy (memcpy.h `Copy<Dst,Src>`)."""
+    import jax
+
+    _, dev = _jax_device(dst_place)
+    return jax.device_put(src, dev)
+
+
+class HostStaging:
+    """Reusable host staging buffers for feed paths (the pinned-memory
+    CPUAllocator idea): one buffer per (shape, dtype), reused across steps so
+    feeding doesn't reallocate host memory every batch."""
+
+    def __init__(self):
+        self._buffers: Dict[tuple, np.ndarray] = {}
+
+    def stage(self, value) -> np.ndarray:
+        a = np.asarray(value)
+        key = (a.shape, a.dtype.str)
+        buf = self._buffers.get(key)
+        if buf is None:
+            buf = np.empty(a.shape, a.dtype)
+            self._buffers[key] = buf
+        np.copyto(buf, a)
+        return buf
+
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self._buffers.values())
+
+    def clear(self) -> None:
+        self._buffers.clear()

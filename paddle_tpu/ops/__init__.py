@@ -13,6 +13,7 @@ from . import sequence_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import optimizer_ops  # noqa: F401

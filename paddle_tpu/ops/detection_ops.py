@@ -1,0 +1,350 @@
+"""Object-detection ops: prior boxes, box coding, IoU, ROI pooling, SSD
+multibox loss, NMS detection output.
+
+Reference surface (SURVEY.md §2.2 'detection_output, roi_pool, box ops' and
+§2.5's legacy PriorBox / MultiBoxLoss / DetectionOutput / ROIPool layers:
+gserver/layers/{PriorBox,MultiBoxLossLayer,DetectionOutputLayer,ROIPoolLayer}
+.cpp, operators/detection_output_op.cc, operators/roi_pool_op.cc,
+operators/math/detection_util.h).  TPU-first design: everything is
+static-shape — gt boxes arrive padded with a per-image count, NMS keeps a
+fixed `keep_top_k` slate padded with -1 rows, and ROI bins are computed by
+masked two-stage max instead of per-roi dynamic loops, so the whole detection
+head stays inside one compiled XLA program."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+def _iou_matrix(jnp, a, b):
+    """a [..,N,4], b [..,M,4] (xmin,ymin,xmax,ymax) → [..,N,M] IoU."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+@register_op("iou_similarity", grad=None)
+def iou_similarity(ctx, ins, attrs):
+    """Pairwise IoU (reference iou_similarity semantics): X [N,4] boxes vs
+    Y [M,4] boxes → [N,M]."""
+    import jax.numpy as jnp
+
+    return {"Out": [_iou_matrix(jnp, ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("box_coder", grad=None, non_diff_inputs=("PriorBox", "PriorBoxVar"))
+def box_coder(ctx, ins, attrs):
+    """Center-size box encoding/decoding against priors (reference
+    detection_util.h EncodeBBoxWithVar/DecodeBBoxWithVar)."""
+    import jax.numpy as jnp
+
+    prior = ins["PriorBox"][0]  # [P,4] corner form
+    pvar = ins["PriorBoxVar"][0]  # [P,4]
+    tb = ins["TargetBox"][0]
+    code = attrs.get("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    if code == "encode_center_size":
+        # tb [G,4] corner → offsets [G,P,4]
+        gw = (tb[:, 2] - tb[:, 0])[:, None]
+        gh = (tb[:, 3] - tb[:, 1])[:, None]
+        gcx = ((tb[:, 0] + tb[:, 2]) / 2)[:, None]
+        gcy = ((tb[:, 1] + tb[:, 3]) / 2)[:, None]
+        out = jnp.stack([
+            (gcx - pcx[None]) / pw[None] / pvar[None, :, 0],
+            (gcy - pcy[None]) / ph[None] / pvar[None, :, 1],
+            jnp.log(jnp.maximum(gw / pw[None], 1e-10)) / pvar[None, :, 2],
+            jnp.log(jnp.maximum(gh / ph[None], 1e-10)) / pvar[None, :, 3],
+        ], axis=-1)
+    else:  # decode_center_size: tb [..,P,4] offsets → corner boxes
+        cx = tb[..., 0] * pvar[:, 0] * pw + pcx
+        cy = tb[..., 1] * pvar[:, 1] * ph + pcy
+        w = jnp.exp(tb[..., 2] * pvar[:, 2]) * pw
+        h = jnp.exp(tb[..., 3] * pvar[:, 3]) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register_op("prior_box", grad=None)
+def prior_box(ctx, ins, attrs):
+    """SSD prior (anchor) boxes for one feature map (reference
+    gserver/layers/PriorBox.cpp): per cell, one box per min_size, one
+    sqrt(min*max) box per max_size, and one per extra aspect ratio (with
+    optional flip), normalized to [0,1] and optionally clipped."""
+    import jax.numpy as jnp
+
+    feat = ins["Input"][0]  # [N,C,H,W]
+    img = ins["Image"][0]  # [N,C,IH,IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            f"prior_box: max_sizes (len {len(max_sizes)}) must be empty or "
+            f"match min_sizes (len {len(min_sizes)})")
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        ar = float(ar)
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+    offset = float(attrs.get("offset", 0.5))
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w  # pixels
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    # box sizes (pixel units), ordering mirrors the reference: for each
+    # min_size: [min, sqrt(min*max) if any, then each extra ar]
+    ws, hs = [], []
+    n_max = len(max_sizes)
+    for i, ms in enumerate(min_sizes):
+        ws.append(ms)
+        hs.append(ms)
+        if n_max:
+            s = (ms * max_sizes[i]) ** 0.5
+            ws.append(s)
+            hs.append(s)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            ws.append(ms * ar ** 0.5)
+            hs.append(ms / ar ** 0.5)
+    ws = jnp.asarray(ws, jnp.float32)[None, None, :]
+    hs = jnp.asarray(hs, jnp.float32)[None, None, :]
+    np_ = ws.shape[-1]
+    full = (H, W, np_)
+    ccx = jnp.broadcast_to(cx[None, :, None], full)
+    ccy = jnp.broadcast_to(cy[:, None, None], full)
+    bw = jnp.broadcast_to(ws, full)
+    bh = jnp.broadcast_to(hs, full)
+    boxes = jnp.stack(
+        [
+            (ccx - bw / 2) / IW,
+            (ccy - bh / 2) / IH,
+            (ccx + bw / 2) / IW,
+            (ccy + bh / 2) / IH,
+        ],
+        axis=-1,
+    )  # [H, W, num_priors, 4]
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("roi_pool", non_diff_inputs=("ROIs",))
+def roi_pool(ctx, ins, attrs):
+    """ROI max pooling (reference roi_pool_op.cc / ROIPoolLayer.cpp): each
+    ROI (batch_idx, x1, y1, x2, y2) is divided into pooled_h x pooled_w bins;
+    output is the max over each bin.  Bins become [R,bins,H]/[R,bins,W]
+    membership masks and two masked max reductions — no per-ROI loops."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [N,C,H,W]
+    rois = ins["ROIs"][0]  # [R,5]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1] * scale)
+    y1 = jnp.round(rois[:, 2] * scale)
+    x2 = jnp.round(rois[:, 3] * scale)
+    y2 = jnp.round(rois[:, 4] * scale)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+
+    def bin_mask(start, extent, bins, size):
+        # [R, bins, size] membership of coordinate c in bin i
+        i = jnp.arange(bins, dtype=jnp.float32)[None, :]
+        lo = jnp.floor(start[:, None] + i * extent[:, None] / bins)
+        hi = jnp.ceil(start[:, None] + (i + 1) * extent[:, None] / bins)
+        c = jnp.arange(size, dtype=jnp.float32)[None, None, :]
+        return (c >= lo[..., None]) & (c < hi[..., None])
+
+    mh = bin_mask(y1, roi_h, ph, H)  # [R, ph, H]
+    mw = bin_mask(x1, roi_w, pw, W)  # [R, pw, W]
+    xg = x[batch_idx]  # [R, C, H, W]
+    neg = jnp.finfo(x.dtype).min
+    # stage 1: max over W into pw bins → [R, C, H, pw]
+    t = jnp.max(
+        jnp.where(mw[:, None, None, :, :], xg[:, :, :, None, :], neg), axis=-1)
+    # stage 2: max over H into ph bins → [R, C, ph, pw]
+    out = jnp.max(
+        jnp.where(mh[:, None, :, None, :],
+                  jnp.moveaxis(t, 2, -1)[:, :, None], neg), axis=-1)
+    # empty bins (degenerate ROIs) → 0, matching the reference's is_empty path
+    any_h = jnp.any(mh, axis=-1)[:, None, :, None]
+    any_w = jnp.any(mw, axis=-1)[:, None, None, :]
+    return {"Out": [jnp.where(any_h & any_w, out, 0.0)]}
+
+
+@register_op("multibox_loss", non_diff_inputs=("PriorBox", "PriorBoxVar",
+                                               "GtBox", "GtLabel", "GtCount"))
+def multibox_loss(ctx, ins, attrs):
+    """SSD training loss (reference MultiBoxLossLayer.cpp): match priors to
+    ground truth by IoU, smooth-L1 localization loss on matched priors,
+    softmax confidence loss with hard-negative mining at `neg_pos_ratio`.
+    Ground truth is padded to a fixed G with a per-image count."""
+    import jax
+    import jax.numpy as jnp
+
+    loc = ins["Loc"][0]  # [N,P,4] predicted offsets
+    conf = ins["Conf"][0]  # [N,P,K] class scores
+    prior = ins["PriorBox"][0]  # [P,4]
+    pvar = ins["PriorBoxVar"][0]  # [P,4]
+    gt = ins["GtBox"][0]  # [N,G,4]
+    gt_label = ins["GtLabel"][0].astype(jnp.int32)  # [N,G]
+    gt_count = ins["GtCount"][0].astype(jnp.int32)  # [N]
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    bg = int(attrs.get("background_label", 0))
+    N, P, K = conf.shape
+    G = gt.shape[1]
+
+    valid_gt = jnp.arange(G)[None, :] < gt_count[:, None]  # [N,G]
+    iou = _iou_matrix(jnp, prior, gt)  # broadcasts to [N,P,G]
+    iou = jnp.where(valid_gt[:, None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=2)  # [N,P]
+    best_iou = jnp.max(iou, axis=2)
+    # bipartite stage: every valid gt claims its best prior regardless of
+    # threshold.  Padded gts scatter to a scratch slot P so they can never
+    # clobber a real claim (duplicate-index .set is order-undefined)
+    best_prior = jnp.argmax(iou, axis=1)  # [N,G]
+    safe_prior = jnp.where(valid_gt, best_prior, P)
+    rows = jnp.arange(N)[:, None]
+    claimed = jnp.zeros((N, P + 1), bool).at[
+        rows, safe_prior].set(True)[:, :P]
+    matched = claimed | (best_iou >= thresh)
+    # prior claimed by gt g overrides its argmax match
+    gt_of_claim = jnp.full((N, P + 1), -1, jnp.int32).at[
+        rows, safe_prior].set(
+        jnp.arange(G, dtype=jnp.int32)[None, :])[:, :P]
+    match_gt = jnp.where(gt_of_claim >= 0, gt_of_claim, best_gt)  # [N,P]
+
+    # localization: smooth-L1 between predicted offsets and encoded targets
+    mg = jnp.take_along_axis(gt, match_gt[..., None], axis=1)  # [N,P,4]
+    gw = mg[..., 2] - mg[..., 0]
+    gh = mg[..., 3] - mg[..., 1]
+    gcx = (mg[..., 0] + mg[..., 2]) / 2
+    gcy = (mg[..., 1] + mg[..., 3]) / 2
+    pw = prior[None, :, 2] - prior[None, :, 0]
+    phh = prior[None, :, 3] - prior[None, :, 1]
+    pcx = (prior[None, :, 0] + prior[None, :, 2]) / 2
+    pcy = (prior[None, :, 1] + prior[None, :, 3]) / 2
+    target = jnp.stack([
+        (gcx - pcx) / pw / pvar[None, :, 0],
+        (gcy - pcy) / phh / pvar[None, :, 1],
+        jnp.log(jnp.maximum(gw / pw, 1e-10)) / pvar[None, :, 2],
+        jnp.log(jnp.maximum(gh / phh, 1e-10)) / pvar[None, :, 3],
+    ], axis=-1)
+    d = loc - jax.lax.stop_gradient(target)
+    sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d, jnp.abs(d) - 0.5)
+    loc_loss = jnp.sum(sl1.sum(-1) * matched, axis=1)  # [N]
+
+    # confidence: softmax CE vs matched gt label (bg for unmatched)
+    tgt_label = jnp.where(
+        matched, jnp.take_along_axis(gt_label, match_gt, axis=1), bg)
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    ce = -jnp.take_along_axis(logp, tgt_label[..., None], axis=2)[..., 0]
+    # hard negative mining: top (neg_ratio * npos) unmatched priors by loss
+    npos = jnp.sum(matched, axis=1)  # [N]
+    nneg = jnp.minimum((neg_ratio * npos).astype(jnp.int32), P)
+    neg_score = jnp.where(matched, -jnp.inf, ce)
+    order = jnp.argsort(-neg_score, axis=1)
+    rank = jnp.argsort(order, axis=1)  # rank of each prior by neg loss
+    selected_neg = (rank < nneg[:, None]) & ~matched
+    conf_loss = jnp.sum(ce * (matched | selected_neg), axis=1)
+
+    denom = jnp.maximum(npos.astype(conf.dtype), 1.0)
+    loss = (loc_loss + conf_loss) / denom
+    return {"Loss": [loss]}
+
+
+@register_op("detection_output", grad=None)
+def detection_output(ctx, ins, attrs):
+    """Inference head (reference DetectionOutputLayer.cpp /
+    detection_output_op.cc): decode predicted offsets against priors, then
+    per-class greedy NMS, keeping a static keep_top_k slate per image padded
+    with -1 labels."""
+    import jax
+    import jax.numpy as jnp
+
+    loc = ins["Loc"][0]  # [N,P,4]
+    conf = ins["Conf"][0]  # [N,P,K] (scores, softmax applied here)
+    prior = ins["PriorBox"][0]  # [P,4]
+    pvar = ins["PriorBoxVar"][0]
+    K = conf.shape[2]
+    score_thresh = float(attrs.get("score_threshold", 0.01))
+    nms_thresh = float(attrs.get("nms_threshold", 0.45))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    bg = int(attrs.get("background_label", 0))
+
+    scores = jax.nn.softmax(conf, axis=-1)
+    # decode boxes once per image
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    cx = loc[..., 0] * pvar[:, 0] * pw + pcx
+    cy = loc[..., 1] * pvar[:, 1] * ph + pcy
+    w = jnp.exp(loc[..., 2] * pvar[:, 2]) * pw
+    h = jnp.exp(loc[..., 3] * pvar[:, 3]) * ph
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+    def nms_one_class(sc, bx):
+        # sc [P], bx [P,4] → (scores, boxes, keep) of top nms_top_k
+        k = min(nms_top_k, sc.shape[0])
+        top_s, top_i = jax.lax.top_k(sc, k)
+        top_b = bx[top_i]
+        iou = _iou_matrix(jnp, top_b, top_b)
+
+        def body(i, keep):
+            # drop i if it overlaps an earlier (higher-scored) kept box
+            earlier = (jnp.arange(k) < i) & keep
+            sup = jnp.any((iou[i] > nms_thresh) & earlier)
+            return keep.at[i].set(keep[i] & ~sup)
+
+        keep0 = top_s > score_thresh
+        keep = jax.lax.fori_loop(0, k, body, keep0)
+        return top_s * keep, top_b, keep
+
+    def per_image(sc_img, bx_img):
+        all_s, all_b, all_l = [], [], []
+        for cls in range(K):
+            if cls == bg:
+                continue
+            s, b, keep = nms_one_class(sc_img[:, cls], bx_img)
+            all_s.append(s)
+            all_b.append(b)
+            all_l.append(jnp.full(s.shape, cls, jnp.float32))
+        s = jnp.concatenate(all_s)
+        b = jnp.concatenate(all_b, axis=0)
+        lbl = jnp.concatenate(all_l)
+        k = min(keep_top_k, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k)
+        out = jnp.concatenate([
+            jnp.where(top_s > 0, lbl[top_i], -1.0)[:, None],
+            top_s[:, None],
+            b[top_i],
+        ], axis=1)  # [k, 6]
+        return out
+
+    out = jax.vmap(per_image)(scores, boxes)
+    return {"Out": [out]}

@@ -78,17 +78,22 @@ class OpTestHarness:
     # ------------------------------------------------------------------
     def check_output(self, expected: Dict[str, np.ndarray], atol=1e-5,
                      rtol=1e-5):
-        prog, _, out_vars = self._build()
-        exe = fluid.Executor(fluid.CPUPlace())
-        scope = fluid.global_scope()
-        self._scope_feed(scope)
-        fetch = [out_vars[s] for s in expected.keys()]
-        got = exe.run(prog, feed={}, fetch_list=fetch)
+        got = self.fetch(list(expected.keys()))
         for (slot, want), g in zip(expected.items(), got):
             np.testing.assert_allclose(
                 g, want, atol=atol, rtol=rtol,
                 err_msg=f"{self.op_type} output {slot} mismatch")
         return got
+
+    # ------------------------------------------------------------------
+    def fetch(self, slots: Optional[List[str]] = None):
+        """Run the op and return its outputs without comparison."""
+        prog, _, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.global_scope()
+        self._scope_feed(scope)
+        slots = slots or self.out_slots
+        return exe.run(prog, feed={}, fetch_list=[out_vars[s] for s in slots])
 
     # ------------------------------------------------------------------
     def check_grad(self, inputs_to_check: List[str], output_slot="Out",

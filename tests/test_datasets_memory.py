@@ -1,0 +1,83 @@
+"""New dataset loaders (conll05, flowers, voc2012, sentiment, mq2007) +
+memory accounting module (reference v2/dataset/* and paddle/memory/)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import conll05, flowers, mq2007, sentiment, voc2012
+
+
+def test_conll05_schema():
+    w, v, l = conll05.get_dict()
+    assert len(l) == conll05.LABEL_DICT_LEN
+    emb = conll05.get_embedding()
+    assert emb.shape[0] == conll05.WORD_DICT_LEN
+    s = next(conll05.test(n=4)())
+    assert len(s) == 9
+    words = s[0]
+    for seq in s[:8]:
+        assert len(seq) == len(words)
+    assert all(0 <= t < conll05.LABEL_DICT_LEN for t in s[8])
+
+
+def test_flowers_schema():
+    img, label = next(flowers.train(n=2)())
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert 0 <= label < flowers.NUM_CLASSES
+
+
+def test_voc2012_schema():
+    img, seg = next(voc2012.train(n=2)())
+    assert img.shape[0] == 3 and img.shape[1:] == seg.shape
+    classes = set(np.unique(seg)) - {voc2012.IGNORE_LABEL}
+    assert classes <= set(range(voc2012.NUM_CLASSES))
+
+
+def test_sentiment_schema():
+    toks, label = next(sentiment.train(n=2)())
+    assert toks.dtype == np.int64 and label in (0, 1)
+    assert len(sentiment.get_word_dict()) == sentiment.WORD_DICT_LEN
+
+
+def test_mq2007_formats():
+    x, y = next(mq2007.train("pointwise", n_queries=2)())
+    assert x.shape == (mq2007.FEATURE_DIM,) and 0 <= y <= mq2007.MAX_REL
+    hi, lo = next(mq2007.train("pairwise", n_queries=2)())
+    assert hi.shape == lo.shape == (mq2007.FEATURE_DIM,)
+    labels, feats = next(mq2007.train("listwise", n_queries=2)())
+    assert len(labels) == len(feats)
+
+
+def test_memory_accounting():
+    from paddle_tpu import memory
+
+    place = fluid.CPUPlace()
+    before = memory.used(place)
+    arr = memory.alloc((256, 256), "float32", place)
+    assert memory.used(place) >= before  # stats or ledger both monotone here
+    assert memory.peak(place) >= memory.used(place)
+    memory.free(arr)
+    assert memory.used(place) <= before + 256 * 256 * 4
+    # stats dict is a plain dict (may be empty on CPU)
+    assert isinstance(memory.memory_stats(place), dict)
+
+
+def test_host_staging_reuses_buffers():
+    from paddle_tpu.memory import HostStaging
+
+    st = HostStaging()
+    a = st.stage(np.ones((8, 8), np.float32))
+    b = st.stage(np.zeros((8, 8), np.float32))
+    assert a is b  # same staging buffer reused
+    assert b[0, 0] == 0.0
+    assert st.nbytes() == 8 * 8 * 4
+    st.clear()
+    assert st.nbytes() == 0
+
+
+def test_memory_copy_roundtrip():
+    from paddle_tpu import memory
+
+    src = np.arange(12, dtype=np.float32).reshape(3, 4)
+    dev = memory.Copy(fluid.CPUPlace(), src)
+    np.testing.assert_array_equal(np.asarray(dev), src)

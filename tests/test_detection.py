@@ -1,0 +1,242 @@
+"""Detection op battery: IoU, box coding, prior boxes, ROI pooling, SSD
+multibox loss, NMS detection output, and the host-side mAP evaluator
+(reference gserver/layers/{PriorBox,MultiBoxLossLayer,DetectionOutputLayer,
+ROIPoolLayer}.cpp + gserver/evaluators/DetectionMAPEvaluator.cpp)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RNG = np.random.RandomState(3)
+
+
+def _iou(a, b):
+    iw = max(min(a[2], b[2]) - max(a[0], b[0]), 0.0)
+    ih = max(min(a[3], b[3]) - max(a[1], b[1]), 0.0)
+    inter = iw * ih
+    ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+    ub = max(b[2] - b[0], 0) * max(b[3] - b[1], 0)
+    return inter / max(ua + ub - inter, 1e-10)
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float64)
+    y = np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float64)
+    t = OpTestHarness("iou_similarity", {"X": x, "Y": y})
+    want = np.array([[_iou(a, b) for b in y] for a in x])
+    t.check_output({"Out": want})
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.8]], np.float64)
+    pvar = np.full((2, 4), 0.1, np.float64)
+    gt = np.array([[0.15, 0.12, 0.55, 0.58]], np.float64)
+    enc = OpTestHarness("box_coder",
+                        {"PriorBox": prior, "PriorBoxVar": pvar,
+                         "TargetBox": gt},
+                        {"code_type": "encode_center_size"},
+                        out_slots=["OutputBox"])
+    (codes,) = enc.fetch(["OutputBox"])
+    assert codes.shape == (1, 2, 4)
+    dec = OpTestHarness("box_coder",
+                        {"PriorBox": prior, "PriorBoxVar": pvar,
+                         "TargetBox": codes[0]},
+                        {"code_type": "decode_center_size"},
+                        out_slots=["OutputBox"])
+    (back,) = dec.fetch(["OutputBox"])
+    np.testing.assert_allclose(back, np.broadcast_to(gt, (2, 4)), atol=1e-8)
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    t = OpTestHarness("prior_box", {"Input": feat, "Image": img},
+                      {"min_sizes": [8.0], "max_sizes": [16.0],
+                       "aspect_ratios": [2.0], "flip": True, "clip": True,
+                       "variances": [0.1, 0.1, 0.2, 0.2]},
+                      out_slots=["Boxes", "Variances"])
+    got_b, got_v = t.fetch()
+    # priors per cell: min + sqrt(min*max) + 2 flipped ARs = 4
+    assert got_b.shape == (4, 4, 4, 4)
+    assert got_v.shape == got_b.shape
+    # first cell center = (0.5*8, 0.5*8) = (4,4); min box = 8x8 → [0,0,8,8]/32
+    np.testing.assert_allclose(got_b[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    np.testing.assert_allclose(got_v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert got_b.min() >= 0.0 and got_b.max() <= 1.0
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float64).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3],   # whole map
+                     [0, 2, 2, 3, 3]], np.float64)  # bottom-right 2x2
+    t = OpTestHarness("roi_pool", {"X": x, "ROIs": rois},
+                      {"pooled_height": 2, "pooled_width": 2,
+                       "spatial_scale": 1.0})
+    want = np.array([
+        [[[5, 7], [13, 15]]],
+        [[[10, 11], [14, 15]]],
+    ], np.float64)
+    t.check_output({"Out": want})
+    t.check_grad(["X"])
+
+
+def test_multibox_loss_decreases_with_better_predictions():
+    P, G, K = 8, 2, 3
+    prior = np.stack([
+        np.linspace(0.0, 0.7, P), np.linspace(0.0, 0.7, P),
+        np.linspace(0.3, 1.0, P), np.linspace(0.3, 1.0, P)], axis=1)
+    pvar = np.full((P, 4), 0.1)
+    gt = np.array([[[0.0, 0.0, 0.32, 0.32], [0.5, 0.5, 0.9, 0.9]]])
+    gt_label = np.array([[1, 2]], np.int64)
+    gt_count = np.array([2], np.int64)
+
+    def run(loc, conf):
+        t = OpTestHarness(
+            "multibox_loss",
+            {"Loc": loc, "Conf": conf, "PriorBox": prior, "PriorBoxVar": pvar,
+             "GtBox": gt, "GtLabel": gt_label, "GtCount": gt_count},
+            {"overlap_threshold": 0.5, "neg_pos_ratio": 3.0,
+             "background_label": 0}, out_slots=["Loss"])
+        (loss,) = t.fetch(["Loss"])
+        return float(loss[0])
+
+    bad_loc = RNG.uniform(-2, 2, (1, P, 4))
+    bad_conf = np.zeros((1, P, K))
+    good_loc = np.zeros((1, P, 4))  # zero offsets = priors themselves
+    good_conf = np.full((1, P, K), -5.0)
+    good_conf[..., 0] = 5.0  # background everywhere...
+    # ...except priors overlapping gt get the right class
+    good_conf[0, 0, 0] = -5.0
+    good_conf[0, 0, 1] = 5.0  # prior 0 ↔ gt0 (class 1)
+    for p in (5, 6):          # priors 5,6 overlap gt1 (class 2) at IoU .5625
+        good_conf[0, p, 0] = -5.0
+        good_conf[0, p, 2] = 5.0
+    assert run(good_loc, good_conf) < run(bad_loc, bad_conf)
+
+
+def test_multibox_loss_grad_flows():
+    P, G, K = 4, 1, 2
+    prior = np.array([[0, 0, 0.5, 0.5], [0.2, 0.2, 0.7, 0.7],
+                      [0.5, 0.5, 1, 1], [0.1, 0.6, 0.4, 0.9]])
+    pvar = np.full((P, 4), 0.1)
+    t = OpTestHarness(
+        "multibox_loss",
+        {"Loc": RNG.uniform(-0.5, 0.5, (1, P, 4)),
+         "Conf": RNG.uniform(-1, 1, (1, P, K)),
+         "PriorBox": prior, "PriorBoxVar": pvar,
+         "GtBox": np.array([[[0.05, 0.05, 0.45, 0.45]]]),
+         "GtLabel": np.array([[1]], np.int64),
+         "GtCount": np.array([1], np.int64)},
+        {"overlap_threshold": 0.5}, out_slots=["Loss"])
+    t.check_grad(["Loc", "Conf"], output_slot="Loss", max_relative_error=1e-2)
+
+
+def test_detection_output_nms():
+    P, K = 4, 2  # 1 real class + background
+    prior = np.array([[0.0, 0.0, 0.4, 0.4],
+                      [0.02, 0.02, 0.42, 0.42],   # overlaps prior 0
+                      [0.6, 0.6, 0.9, 0.9],
+                      [0.0, 0.6, 0.3, 0.9]], np.float64)
+    pvar = np.full((P, 4), 0.1)
+    loc = np.zeros((1, P, 4))  # decoded boxes = priors
+    conf = np.full((1, P, K), -8.0)
+    conf[0, 0, 1] = 4.0   # strong det, class 1
+    conf[0, 1, 1] = 3.0   # duplicate of det 0 → suppressed
+    conf[0, 2, 1] = 2.0   # separate det
+    conf[0, 3, 0] = 4.0   # background → no detection
+    t = OpTestHarness(
+        "detection_output",
+        {"Loc": loc, "Conf": conf, "PriorBox": prior, "PriorBoxVar": pvar},
+        {"score_threshold": 0.5, "nms_threshold": 0.45, "nms_top_k": 4,
+         "keep_top_k": 3, "background_label": 0})
+    (out,) = t.fetch()
+    assert out.shape == (1, 3, 6)
+    labels = out[0, :, 0]
+    # two surviving detections (priors 0 and 2), third row padded -1
+    assert (labels >= 0).sum() == 2
+    kept = out[0][labels >= 0]
+    np.testing.assert_allclose(kept[0, 2:], prior[0], atol=1e-6)
+    np.testing.assert_allclose(kept[1, 2:], prior[2], atol=1e-6)
+    assert kept[0, 1] > kept[1, 1]  # sorted by score
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.evaluator import DetectionMAP
+
+    ev = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # image 0: one gt of class 1; perfect detection + one false positive
+    dets = np.array([[[1, 0.9, 0.0, 0.0, 0.4, 0.4],
+                      [1, 0.8, 0.6, 0.6, 0.9, 0.9],
+                      [-1, 0, 0, 0, 0, 0]]])
+    gtb = np.array([[[0.0, 0.0, 0.4, 0.4]]])
+    gtl = np.array([[1]])
+    ev.add_batch(dets, gtb, gtl, np.array([1]))
+    # AP: first det TP (rec 1.0, prec 1.0), second FP → AP = 1.0
+    assert ev.eval() == pytest.approx(1.0)
+    ev.reset()
+    # now the high-scoring det is the FP → prec at rec 1.0 is 0.5
+    dets2 = dets.copy()
+    dets2[0, 0, 1], dets2[0, 1, 1] = 0.8, 0.9
+    ev.add_batch(dets2, gtb, gtl, np.array([1]))
+    assert ev.eval() == pytest.approx(0.5)
+
+
+def test_multibox_loss_padded_gt_cannot_clobber_claim():
+    """A padding gt row must not erase a valid gt's bipartite claim (the
+    duplicate-index scatter hazard): with one valid low-IoU gt, its best
+    prior must still be matched."""
+    P, K = 3, 2
+    prior = np.array([[0, 0, 0.2, 0.2], [0.4, 0.4, 0.6, 0.6],
+                      [0.7, 0.7, 1, 1]], np.float64)
+    pvar = np.full((P, 4), 0.1)
+    # gt overlaps prior 0 only slightly (IoU < 0.5) → only bipartite claims it
+    gt = np.array([[[0.1, 0.1, 0.5, 0.5], [0, 0, 0, 0]]])  # row 1 = padding
+    t = OpTestHarness(
+        "multibox_loss",
+        {"Loc": np.zeros((1, P, 4)), "Conf": np.zeros((1, P, K)),
+         "PriorBox": prior, "PriorBoxVar": pvar,
+         "GtBox": gt, "GtLabel": np.array([[1, 0]], np.int64),
+         "GtCount": np.array([1], np.int64)},
+        {"overlap_threshold": 0.5, "neg_pos_ratio": 0.0},
+        out_slots=["Loss"])
+    (loss,) = t.fetch(["Loss"])
+    # npos must be 1 (the claimed prior) → conf CE ln(2) + its loc loss > 0
+    assert loss[0] > 0.5
+
+
+def test_detection_map_duplicate_is_fp():
+    """VOC protocol: second detection on an already-matched gt is FP even if
+    another unmatched gt overlaps it less."""
+    from paddle_tpu.evaluator import DetectionMAP
+
+    ev = DetectionMAP(overlap_threshold=0.3, ap_version="integral")
+    # gt A [0,0,.4,.4]; gt B [0.3,0.3,.7,.7] overlaps A region partially
+    gtb = np.array([[[0.0, 0.0, 0.4, 0.4], [0.3, 0.3, 0.7, 0.7]]])
+    gtl = np.array([[1, 1]])
+    # both detections sit on A (best IoU = A); second must be FP, not
+    # re-assigned to B
+    dets = np.array([[[1, 0.9, 0.0, 0.0, 0.4, 0.4],
+                      [1, 0.8, 0.02, 0.02, 0.44, 0.44],
+                      [-1, 0, 0, 0, 0, 0]]])
+    ev.add_batch(dets, gtb, gtl, np.array([2]))
+    # TP at rank 1 (rec .5, prec 1), FP at rank 2 → integral AP = 0.5
+    assert ev.eval() == pytest.approx(0.5)
+
+
+def test_detection_map_difficult_gt():
+    from paddle_tpu.evaluator import DetectionMAP
+
+    gtb = np.array([[[0.0, 0.0, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+    gtl = np.array([[1, 1]])
+    diff = np.array([[False, True]])
+    dets = np.array([[[1, 0.9, 0.0, 0.0, 0.4, 0.4],     # TP on easy gt
+                      [1, 0.8, 0.6, 0.6, 0.9, 0.9],     # hits difficult gt
+                      [-1, 0, 0, 0, 0, 0]]])
+    ev = DetectionMAP(overlap_threshold=0.5)
+    ev.add_batch(dets, gtb, gtl, np.array([2]), gt_difficult=diff)
+    # difficult gt ignored: npos=1, det on it neither TP nor FP → AP 1.0
+    assert ev.eval() == pytest.approx(1.0)
+    ev2 = DetectionMAP(overlap_threshold=0.5, evaluate_difficult=True)
+    ev2.add_batch(dets, gtb, gtl, np.array([2]), gt_difficult=diff)
+    assert ev2.eval() == pytest.approx(1.0)  # both dets TP, npos=2
