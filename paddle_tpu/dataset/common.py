@@ -45,4 +45,10 @@ def cluster_files_reader(files_pattern, trainer_count, trainer_id,
 
 
 def synthetic_rng(name: str, seed_base: int = 0):
-    return np.random.RandomState(abs(hash(name)) % (2**31) + seed_base)
+    # zlib.crc32, not hash(): str hashing is salted per process
+    # (PYTHONHASHSEED), which would make synthetic datasets and surrogate
+    # embedding tables differ between train and inference processes
+    import zlib
+
+    return np.random.RandomState(
+        (zlib.crc32(name.encode()) % (2**31)) + seed_base)

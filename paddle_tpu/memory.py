@@ -90,8 +90,15 @@ def total(place: Optional[Place] = None) -> int:
 
 
 def available(place: Optional[Place] = None) -> int:
-    t = total(place)
-    return max(t - used(place), 0) if t else 0
+    place, _ = _jax_device(place)
+    stats = memory_stats(place)  # one device query for both quantities
+    t = next((int(stats[k]) for k in ("bytes_limit",
+                                      "bytes_reservable_limit")
+              if k in stats), 0)
+    if not t:
+        return 0
+    u = int(stats.get("bytes_in_use", 0))
+    return max(t - u, 0)
 
 
 def alloc(shape, dtype="float32", place: Optional[Place] = None):
@@ -135,15 +142,17 @@ def Copy(dst_place: Place, src, src_place: Optional[Place] = None):
 
 class HostStaging:
     """Reusable host staging buffers for feed paths (the pinned-memory
-    CPUAllocator idea): one buffer per (shape, dtype), reused across steps so
-    feeding doesn't reallocate host memory every batch."""
+    CPUAllocator idea): one buffer per (slot, shape, dtype), reused across
+    steps so feeding doesn't reallocate host memory every batch.  Keyed by
+    the feed slot name — two same-shaped slots must never alias, or both
+    would silently read the last-staged value."""
 
     def __init__(self):
         self._buffers: Dict[tuple, np.ndarray] = {}
 
-    def stage(self, value) -> np.ndarray:
+    def stage(self, name: str, value) -> np.ndarray:
         a = np.asarray(value)
-        key = (a.shape, a.dtype.str)
+        key = (name, a.shape, a.dtype.str)
         buf = self._buffers.get(key)
         if buf is None:
             buf = np.empty(a.shape, a.dtype)

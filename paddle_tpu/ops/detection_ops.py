@@ -325,18 +325,16 @@ def detection_output(ctx, ins, attrs):
         keep = jax.lax.fori_loop(0, k, body, keep0)
         return top_s * keep, top_b, keep
 
+    fg_classes = [c for c in range(K) if c != bg]
+    cls_ids = jnp.asarray(fg_classes, jnp.float32)
+
     def per_image(sc_img, bx_img):
-        all_s, all_b, all_l = [], [], []
-        for cls in range(K):
-            if cls == bg:
-                continue
-            s, b, keep = nms_one_class(sc_img[:, cls], bx_img)
-            all_s.append(s)
-            all_b.append(b)
-            all_l.append(jnp.full(s.shape, cls, jnp.float32))
-        s = jnp.concatenate(all_s)
-        b = jnp.concatenate(all_b, axis=0)
-        lbl = jnp.concatenate(all_l)
+        # one vmapped NMS over the class axis instead of a K-unrolled Python
+        # loop: program size stays constant in num_classes
+        sc_t = sc_img[:, jnp.asarray(fg_classes, jnp.int32)].T  # [K-1, P]
+        s, b, _ = jax.vmap(nms_one_class, in_axes=(0, None))(sc_t, bx_img)
+        lbl = jnp.broadcast_to(cls_ids[:, None], s.shape)
+        s, b, lbl = s.reshape(-1), b.reshape(-1, 4), lbl.reshape(-1)
         k = min(keep_top_k, s.shape[0])
         top_s, top_i = jax.lax.top_k(s, k)
         out = jnp.concatenate([

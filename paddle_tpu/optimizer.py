@@ -289,10 +289,39 @@ class RMSPropOptimizer(Optimizer):
         )
 
 
+class AdadeltaOptimizer(Optimizer):
+    """Adadelta (reference operators/adadelta_op.cc; legacy
+    FirstOrderOptimizer AdaDelta): learning-rate-free accumulator update."""
+
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_sq_grad", p)
+            self._add_accumulator("avg_sq_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        sq = self._get_accumulator("avg_sq_grad", p)
+        upd = self._get_accumulator("avg_sq_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "AvgSquaredGrad": [sq.name],
+                    "AvgSquaredUpdate": [upd.name]},
+            outputs={"ParamOut": [p.name], "AvgSquaredGradOut": [sq.name],
+                     "AvgSquaredUpdateOut": [upd.name]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer
 Adam = AdamOptimizer
 Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
 RMSProp = RMSPropOptimizer

@@ -1,0 +1,34 @@
+"""v1 config API (reference python/paddle/trainer_config_helpers/): the
+declarative layer functions + networks presets + activation/pooling/attr
+objects + settings()-style optimizer config, re-based onto the Program IR.
+
+    from paddle_tpu.v1 import *
+
+    settings(batch_size=128, learning_rate=1e-3,
+             learning_method=AdamOptimizer())
+    img = data_layer("pixel", size=784)
+    hidden = fc_layer(img, size=200, act=TanhActivation())
+    pred = fc_layer(hidden, size=10, act=SoftmaxActivation())
+    cost = classification_cost(pred, data_layer("label", size=10,
+                                                dtype="int64"))
+    prog = parse_network(cost)  # the Program IS the parsed config
+"""
+
+from .activations import *  # noqa: F401,F403
+from .attrs import ExtraAttr, ExtraLayerAttribute, ParamAttr, \
+    ParameterAttribute  # noqa: F401
+from .evaluators import (auc_evaluator, chunk_evaluator,  # noqa: F401
+                         classification_error_evaluator, ctc_error_evaluator,
+                         pnpair_evaluator, precision_recall_evaluator)
+from .layers import *  # noqa: F401,F403
+from .layers import LayerOutput  # noqa: F401
+from .networks import (bidirectional_gru, bidirectional_lstm,  # noqa: F401
+                       img_conv_group, sequence_conv_pool, simple_attention,
+                       simple_gru, simple_img_conv_pool, simple_lstm,
+                       vgg_16_network)
+from .optimizers import (AdaDeltaOptimizer, AdaGradOptimizer,  # noqa: F401
+                         AdamOptimizer, AdamaxOptimizer,
+                         DecayedAdaGradOptimizer, MomentumOptimizer,
+                         RMSPropOptimizer, optimizer_from_settings, settings)
+from .poolings import (AvgPooling, FirstPooling, LastPooling,  # noqa: F401
+                       MaxPooling, SqrtAvgPooling, SumPooling)

@@ -1,0 +1,91 @@
+"""v1 evaluator functions (reference trainer_config_helpers/evaluators.py:
+classification_error, auc, pnpair, precision_recall, ctc_error, chunk).
+
+Each appends the corresponding metric op to the current program and returns
+the metric variable(s) to fetch — evaluators are graph pieces, as in the
+reference (SURVEY.md §5 'evaluators as first-class graph pieces')."""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+from .layers import get_length_var
+from .layers import _var
+
+
+def classification_error_evaluator(input, label, name=None, top_k=1):
+    """error rate = 1 - accuracy (evaluators.py classification_error)."""
+    from .. import layers as fl
+
+    acc = fl.accuracy(_var(input), _var(label), k=top_k)
+    return fl.scale(acc, scale=-1.0, bias=1.0)
+
+
+def auc_evaluator(input, label, name=None):
+    from .. import layers as fl
+
+    return fl.auc(_var(input), _var(label))
+
+
+def precision_recall_evaluator(input, label, class_number, name=None):
+    from .. import layers as fl
+
+    helper = LayerHelper("precision_recall")
+    _, idx = fl.topk(_var(input), 1)
+    batch = helper.create_tmp_variable("float32", shape=(3,))
+    accum = helper.create_tmp_variable("float32", shape=(3,))
+    helper.append_op(
+        "precision_recall",
+        inputs={"Indices": [idx.name], "Label": [_var(label).name]},
+        outputs={"BatchMetrics": [batch.name], "AccumMetrics": [accum.name]},
+        attrs={"class_number": int(class_number)})
+    return batch
+
+
+def pnpair_evaluator(input, label, query_id, name=None):
+    helper = LayerHelper("pnpair")
+    outs = [helper.create_tmp_variable("float32", shape=(1,))
+            for _ in range(3)]
+    helper.append_op(
+        "positive_negative_pair",
+        inputs={"Score": [_var(input).name], "Label": [_var(label).name],
+                "QueryID": [_var(query_id).name]},
+        outputs={"PositivePair": [outs[0].name],
+                 "NegativePair": [outs[1].name],
+                 "NeutralPair": [outs[2].name]})
+    return tuple(outs)
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types, name=None):
+    helper = LayerHelper("chunk_eval")
+    iv, lv = _var(input), _var(label)
+    length = get_length_var(iv) or get_length_var(lv)
+    outs = {s: helper.create_tmp_variable(
+        "float32" if i < 3 else "int64", shape=(1,))
+        for i, s in enumerate(["Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"])}
+    helper.append_op(
+        "chunk_eval",
+        inputs={"Inference": [iv.name], "Label": [lv.name],
+                "Length": [length.name if length is not None else ""]},
+        outputs={k: [v.name] for k, v in outs.items()},
+        attrs={"num_chunk_types": int(num_chunk_types),
+               "chunk_scheme": chunk_scheme})
+    return outs["Precision"], outs["Recall"], outs["F1-Score"]
+
+
+def ctc_error_evaluator(input, label, name=None):
+    """Sequence edit-distance rate (evaluators.py ctc_error)."""
+    helper = LayerHelper("edit_distance")
+    iv, lv = _var(input), _var(label)
+    hyp_len = get_length_var(iv)
+    ref_len = get_length_var(lv)
+    dist = helper.create_tmp_variable("float32", shape=(0,))
+    seqn = helper.create_tmp_variable("int64", shape=(1,))
+    helper.append_op(
+        "edit_distance",
+        inputs={"Hyps": [iv.name], "Refs": [lv.name],
+                "HypsLength": [hyp_len.name], "RefsLength": [ref_len.name]},
+        outputs={"Out": [dist.name], "SequenceNum": [seqn.name]},
+        attrs={"normalized": True})
+    return dist

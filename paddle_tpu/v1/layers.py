@@ -1,0 +1,605 @@
+"""v1 layer API (reference python/paddle/trainer_config_helpers/layers.py,
+7531 LoC / 72 ``*_layer`` functions mapping onto gserver Layer classes,
+SURVEY.md §2.13).
+
+TPU-native stance: instead of emitting a `ModelConfig` protobuf interpreted
+by a C++ trainer, every v1 function builds the same Program IR the fluid
+layer API builds (one graph representation, compiled whole-program to XLA —
+SURVEY.md §7's "the lowering is the only consumer").  `LayerOutput` carries
+the fluid Variable plus the v1 metadata (size, activation) so v1 configs
+compose exactly as in the reference; `parse_network` returns the Program the
+way config_parser.parse_config returned the proto."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from .. import layers as fl
+from ..framework.core import Program, Variable, default_main_program
+from ..framework.layer_helper import LayerHelper
+from ..layers.sequence import get_length_var as _get_length_strict
+from ..layers.sequence import propagate_length
+
+
+def get_length_var(var):
+    """Non-raising probe: the v1 API dispatches dense-vs-sequence on this."""
+    if getattr(var, "_length_var_name", None) is None:
+        return None
+    return _get_length_strict(var)
+from .activations import BaseActivation, LinearActivation, TanhActivation, \
+    SigmoidActivation, SoftmaxActivation, act_name
+from .attrs import to_param_attr
+from .poolings import AvgPooling, MaxPooling, pool_name
+
+
+class LayerOutput:
+    """v1 handle (layers.py LayerOutput): wraps the fluid Variable."""
+
+    def __init__(self, var: Variable, layer_type: str, size: Optional[int] = None,
+                 parents: Sequence["LayerOutput"] = (), act: Optional[str] = None):
+        self.var = var
+        self.name = var.name
+        self.layer_type = layer_type
+        self.size = size
+        self.parents = list(parents)
+        self.act = act  # applied activation op name (v1 active_type)
+
+    def __repr__(self):
+        return f"LayerOutput({self.name!r}, type={self.layer_type}, size={self.size})"
+
+
+def _var(x) -> Variable:
+    return x.var if isinstance(x, LayerOutput) else x
+
+
+def _vars(xs):
+    return [_var(x) for x in (xs if isinstance(xs, (list, tuple)) else [xs])]
+
+
+def _wrap(var, layer_type, size=None, parents=(), act=None):
+    return LayerOutput(var, layer_type, size=size, parents=parents, act=act)
+
+
+def _apply_act(var, act):
+    a = act_name(act)
+    if not a:
+        return var
+    helper = LayerHelper("activation", act=a)
+    return helper.append_activation(var)
+
+
+# --- data --------------------------------------------------------------------
+
+def data_layer(name, size, depth=None, height=None, width=None,
+               dtype="float32", seq=False):
+    """DataLayer (layers.py data_layer).  v1 infers density/sequence-ness
+    from the DataProvider; here `seq=True` declares a ragged input with a
+    companion length vector, and (height,width) spatial inputs become CHW."""
+    if seq:
+        shape = [size] if dtype != "int64" else [1]
+        v = fl.sequence_data(name, shape=shape, dtype=dtype)
+    elif height and width:
+        channels = size // (height * width)
+        v = fl.data(name, shape=[channels, height, width], dtype=dtype)
+    else:
+        v = fl.data(name, shape=[size] if dtype != "int64" else [1],
+                    dtype=dtype)
+    return _wrap(v, "data", size=size)
+
+
+# --- dense / embedding -------------------------------------------------------
+
+def fc_layer(input, size, act=None, param_attr=None, bias_attr=None,
+             layer_attr=None, name=None):
+    """FcLayer (layers.py fc_layer; gserver/layers/FullyConnectedLayer)."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    seq = any(get_length_var(_var(i)) is not None for i in ins)
+    fn = fl.sequence_fc if seq else fl.fc
+    outs = None
+    if seq:
+        out = None
+        for i in ins:  # sequence_fc takes one input; sum multi-input
+            o = fl.sequence_fc(_var(i), size=size,
+                               param_attr=to_param_attr(param_attr))
+            out = o if out is None else fl.elementwise_add(out, o)
+        out = _apply_act(out, act)
+    else:
+        out = fl.fc([_var(i) for i in ins], size=size,
+                    act=act_name(act), param_attr=to_param_attr(param_attr),
+                    bias_attr=bias_attr)
+    return _wrap(out, "fc", size=size, parents=ins, act=act_name(act))
+
+
+def embedding_layer(input, size, param_attr=None):
+    """table_projection/embedding (layers.py embedding_layer)."""
+    iv = _var(input)
+    vocab = input.size if isinstance(input, LayerOutput) else None
+    if vocab is None:
+        raise ValueError("embedding_layer needs a data_layer input with size")
+    if get_length_var(iv) is not None:
+        out = fl.sequence_embedding(iv, size=[vocab, size],
+                                    param_attr=to_param_attr(param_attr))
+    else:
+        out = fl.embedding(iv, size=[vocab, size],
+                           param_attr=to_param_attr(param_attr))
+    return _wrap(out, "embedding", size=size, parents=[input])
+
+
+# --- convolution stack -------------------------------------------------------
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, groups=1, act=None, param_attr=None,
+                   bias_attr=None, shared_biases=True, name=None,
+                   layer_attr=None):
+    """ExpandConvLayer (layers.py img_conv_layer)."""
+    out = fl.conv2d(_var(input), num_filters=num_filters,
+                    filter_size=filter_size, stride=stride, padding=padding,
+                    groups=groups, act=act_name(act),
+                    param_attr=to_param_attr(param_attr), bias_attr=bias_attr)
+    return _wrap(out, "conv", size=num_filters, parents=[input])
+
+
+def img_pool_layer(input, pool_size, stride=None, pool_type=None, padding=0,
+                   name=None, layer_attr=None):
+    """PoolLayer (layers.py img_pool_layer)."""
+    pt = pool_name(pool_type or MaxPooling)
+    pt = {"sum": "average", "sqrt": "average"}.get(pt, pt)  # img pools: max/avg
+    out = fl.pool2d(_var(input), pool_size=pool_size,
+                    pool_type="avg" if pt == "average" else pt,
+                    pool_stride=stride or pool_size, pool_padding=padding)
+    return _wrap(out, "pool", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, name=None):
+    """CMRProjectionNormLayer — cross-map response norm, i.e. LRN
+    (layers.py img_cmrnorm_layer)."""
+    helper = LayerHelper("lrn")
+    iv = _var(input)
+    out = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    mid = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    helper.append_op("lrn", inputs={"X": [iv.name]},
+                     outputs={"Out": [out.name], "MidOut": [mid.name]},
+                     attrs={"n": int(size), "alpha": float(scale),
+                            "beta": float(power), "k": 1.0})
+    return _wrap(out, "norm", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def batch_norm_layer(input, act=None, bias_attr=None, param_attr=None,
+                     use_global_stats=None, moving_average_fraction=0.9,
+                     name=None):
+    """BatchNormalizationLayer (layers.py batch_norm_layer)."""
+    out = fl.batch_norm(_var(input), act=act_name(act),
+                        momentum=moving_average_fraction,
+                        is_test=bool(use_global_stats))
+    return _wrap(out, "batch_norm", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    out = fl.dropout(_var(input), dropout_prob=dropout_rate)
+    return _wrap(out, "dropout", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def maxout_layer(input, groups, num_channels=None, name=None):
+    helper = LayerHelper("maxout")
+    iv = _var(input)
+    out = helper.create_tmp_variable(iv.dtype, shape=None)
+    helper.append_op("maxout", inputs={"X": [iv.name]},
+                     outputs={"Out": [out.name]}, attrs={"groups": groups})
+    return _wrap(out, "maxout", parents=[input])
+
+
+# --- combination layers ------------------------------------------------------
+
+def concat_layer(input, act=None, name=None):
+    """ConcatenateLayer: feature-axis concat (layers.py concat_layer)."""
+    out = fl.concat(_vars(input), axis=-1)
+    first = input[0]
+    lv = get_length_var(_var(first))
+    if lv is not None:
+        propagate_length(_var(first), out)
+    out = _apply_act(out, act)
+    size = sum(i.size for i in input if isinstance(i, LayerOutput)) \
+        if all(isinstance(i, LayerOutput) and i.size for i in input) else None
+    return _wrap(out, "concat", size=size, parents=list(input))
+
+
+def addto_layer(input, act=None, bias_attr=None, name=None):
+    """AddtoLayer: elementwise sum of inputs (layers.py addto_layer)."""
+    vs = _vars(input)
+    out = vs[0]
+    for v in vs[1:]:
+        out = fl.elementwise_add(out, v)
+    lv = get_length_var(vs[0])
+    if lv is not None:
+        propagate_length(vs[0], out)
+    out = _apply_act(out, act)
+    return _wrap(out, "addto", size=getattr(input[0], "size", None),
+                 parents=list(input))
+
+
+# --- mixed layer + projections ----------------------------------------------
+
+class _Projection:
+    def __init__(self, fn, size_hint=None):
+        self.fn = fn
+        self.size_hint = size_hint
+
+
+def full_matrix_projection(input, size, param_attr=None):
+    def fn(target_size):
+        return fl.fc(_var(input), size=target_size,
+                     param_attr=to_param_attr(param_attr))
+    return _Projection(fn, size_hint=size)
+
+
+def identity_projection(input, offset=None):
+    def fn(target_size):
+        return _var(input)
+    return _Projection(fn, size_hint=getattr(input, "size", None))
+
+
+def table_projection(input, size, param_attr=None):
+    def fn(target_size):
+        vocab = input.size
+        return fl.embedding(_var(input), size=[vocab, target_size],
+                            param_attr=to_param_attr(param_attr))
+    return _Projection(fn, size_hint=size)
+
+
+def dotmul_projection(input, param_attr=None):
+    def fn(target_size):
+        helper = LayerHelper("dotmul", param_attr=to_param_attr(param_attr))
+        iv = _var(input)
+        w = helper.create_parameter(
+            attr=to_param_attr(param_attr) or {},
+            shape=[int(iv.shape[-1])], dtype=iv.dtype)
+        return fl.elementwise_mul(iv, w)
+    return _Projection(fn, size_hint=getattr(input, "size", None))
+
+
+def mixed_layer(size=0, input=None, act=None, bias_attr=None, name=None):
+    """MixedLayer (layers.py mixed_layer): sums its projections.  The 12
+    projection/operator types of the reference reduce to these four plus the
+    conv/context operators available as standalone layers."""
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    acc = None
+    for p in projs:
+        v = p.fn(size or p.size_hint)
+        acc = v if acc is None else fl.elementwise_add(acc, v)
+    acc = _apply_act(acc, act)
+    return _wrap(acc, "mixed", size=size or projs[0].size_hint)
+
+
+# --- sequence layers ---------------------------------------------------------
+
+def pooling_layer(input, pooling_type=None, name=None, agg_level=None):
+    """SequencePoolLayer (layers.py pooling_layer)."""
+    pt = pool_name(pooling_type or AvgPooling)
+    out = fl.sequence_pool(_var(input), pool_type=pt)
+    return _wrap(out, "seqpool", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def last_seq(input, name=None, agg_level=None):
+    out = fl.sequence_pool(_var(input), pool_type="last")
+    return _wrap(out, "last_seq", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def first_seq(input, name=None, agg_level=None):
+    out = fl.sequence_pool(_var(input), pool_type="first")
+    return _wrap(out, "first_seq", size=getattr(input, "size", None),
+                 parents=[input])
+
+
+def expand_layer(input, expand_as, name=None):
+    """ExpandLayer: broadcast one row per sequence over its steps."""
+    helper = LayerHelper("sequence_expand")
+    iv, ev = _var(input), _var(expand_as)
+    lv = get_length_var(ev)
+    T = ev.shape[1] if ev.shape else None
+    out = helper.create_tmp_variable(iv.dtype, shape=None)
+    helper.append_op(
+        "sequence_expand",
+        inputs={"X": [iv.name], "Length": [lv.name if lv is not None else ""]},
+        outputs={"Out": [out.name]}, attrs={"max_len": int(T)})
+    if lv is not None:
+        propagate_length(ev, out)
+    return _wrap(out, "expand", size=getattr(input, "size", None),
+                 parents=[input, expand_as])
+
+
+def lstmemory(input, size=None, reverse=False, act=None, gate_act=None,
+              state_act=None, param_attr=None, bias_attr=None, name=None):
+    """LstmLayer (layers.py lstmemory): input must already be the 4x
+    projection (as in v1, where mixed/fc feeds it)."""
+    iv = _var(input)
+    H = size or int(iv.shape[-1]) // 4
+    if reverse:
+        iv = fl.sequence_reverse(iv)
+    hidden, _ = fl.dynamic_lstm(iv, size=4 * H,
+                                param_attr=to_param_attr(param_attr))
+    if reverse:
+        hidden = fl.sequence_reverse(hidden)
+    return _wrap(hidden, "lstmemory", size=H, parents=[input])
+
+
+def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
+              param_attr=None, bias_attr=None, name=None):
+    """GruLayer (layers.py grumemory): input is the 3x projection."""
+    iv = _var(input)
+    H = size or int(iv.shape[-1]) // 3
+    if reverse:
+        iv = fl.sequence_reverse(iv)
+    hidden = fl.dynamic_gru(iv, size=H, param_attr=to_param_attr(param_attr))
+    if reverse:
+        hidden = fl.sequence_reverse(hidden)
+    return _wrap(hidden, "grumemory", size=H, parents=[input])
+
+
+def context_projection(input, context_len, context_start=None):
+    def fn(target_size):
+        return fl.sequence_conv(_var(input), num_filters=target_size,
+                                filter_size=context_len)
+    return _Projection(fn)
+
+
+def seq_reshape_layer(input, reshape_size, name=None):
+    helper = LayerHelper("sequence_reshape")
+    iv = _var(input)
+    lv = get_length_var(iv)
+    out = helper.create_tmp_variable(iv.dtype, shape=None)
+    lout = helper.create_tmp_variable("int32", shape=None)
+    helper.append_op(
+        "sequence_reshape",
+        inputs={"X": [iv.name], "Length": [lv.name]},
+        outputs={"Out": [out.name], "LengthOut": [lout.name]},
+        attrs={"new_dim": int(reshape_size)})
+    from ..layers.sequence import _set_length
+
+    _set_length(out, lout.name)
+    return _wrap(out, "seq_reshape", size=reshape_size, parents=[input])
+
+
+# --- elementwise utility layers ---------------------------------------------
+
+def trans_layer(input, name=None):
+    out = fl.transpose(_var(input), perm=[1, 0])
+    return _wrap(out, "trans", parents=[input])
+
+
+def scaling_layer(input, weight, name=None):
+    """ScalingLayer: per-row scalar weight times input."""
+    out = fl.elementwise_mul(_var(input), _var(weight))
+    return _wrap(out, "scaling", size=getattr(input, "size", None))
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None):
+    out = fl.scale(_var(input), scale=float(slope), bias=float(intercept))
+    return _wrap(out, "slope_intercept", size=getattr(input, "size", None))
+
+
+def interpolation_layer(input, weight, name=None):
+    """out = w*a + (1-w)*b (layers.py interpolation_layer)."""
+    a, b = input
+    w = _var(weight)
+    wa = fl.elementwise_mul(_var(a), w)
+    one_minus = fl.scale(w, scale=-1.0, bias=1.0)
+    wb = fl.elementwise_mul(_var(b), one_minus)
+    return _wrap(fl.elementwise_add(wa, wb), "interpolation",
+                 size=getattr(a, "size", None))
+
+
+def power_layer(input, weight, name=None):
+    helper = LayerHelper("pow")
+    iv, wv = _var(input), _var(weight)
+    out = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    helper.append_op("elementwise_pow",
+                     inputs={"X": [iv.name], "Y": [wv.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return _wrap(out, "power", size=getattr(input, "size", None))
+
+
+def clip_layer(input, min, max, name=None):
+    helper = LayerHelper("clip")
+    iv = _var(input)
+    out = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    helper.append_op("clip", inputs={"X": [iv.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"min": float(min), "max": float(max)})
+    return _wrap(out, "clip", size=getattr(input, "size", None))
+
+
+def cos_sim(a, b, scale=1.0, size=1, name=None):
+    helper = LayerHelper("cos_sim")
+    av, bv = _var(a), _var(b)
+    out = helper.create_tmp_variable(av.dtype, shape=(av.shape[0], 1))
+    helper.append_op("cos_sim", inputs={"X": [av.name], "Y": [bv.name]},
+                     outputs={"Out": [out.name]})
+    if scale != 1.0:
+        out = fl.scale(out, scale=float(scale))
+    return _wrap(out, "cos_sim", size=1)
+
+
+def tensor_layer(a, b, size, act=None, param_attr=None, bias_attr=None,
+                 name=None):
+    """TensorLayer → bilinear_tensor_product."""
+    helper = LayerHelper("bilinear", param_attr=to_param_attr(param_attr))
+    av, bv = _var(a), _var(b)
+    w = helper.create_parameter(
+        attr=to_param_attr(param_attr) or {},
+        shape=[size, int(av.shape[-1]), int(bv.shape[-1])], dtype=av.dtype)
+    out = helper.create_tmp_variable(av.dtype, shape=(av.shape[0], size))
+    helper.append_op("bilinear_tensor_product",
+                     inputs={"X": [av.name], "Y": [bv.name], "Weight": [w.name]},
+                     outputs={"Out": [out.name]})
+    return _wrap(_apply_act(out, act), "tensor", size=size)
+
+
+def max_id_layer(input, name=None):
+    helper = LayerHelper("arg_max")
+    iv = _var(input)
+    out = helper.create_tmp_variable("int64", shape=(iv.shape[0], 1))
+    helper.append_op("arg_max", inputs={"X": [iv.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": -1})
+    return _wrap(out, "max_id", size=1, parents=[input])
+
+
+def conv_shift_layer(a, b, name=None):
+    helper = LayerHelper("conv_shift")
+    av, bv = _var(a), _var(b)
+    out = helper.create_tmp_variable(av.dtype, shape=av.shape)
+    helper.append_op("conv_shift", inputs={"X": [av.name], "Y": [bv.name]},
+                     outputs={"Out": [out.name]})
+    return _wrap(out, "conv_shift", size=getattr(a, "size", None))
+
+
+# --- cost layers -------------------------------------------------------------
+
+def classification_cost(input, label, name=None, evaluator=None,
+                        layer_attr=None):
+    """Softmax + cross-entropy (layers.py classification_cost).  v1 applied
+    softmax via the input layer's activation; accept either way."""
+    iv = _var(input)
+    ce = fl.cross_entropy(fl.softmax(iv) if _needs_softmax(input) else iv,
+                          _var(label))
+    out = fl.mean(ce)
+    return _wrap(out, "cost", size=1, parents=[input, label])
+
+
+def _needs_softmax(input):
+    # fc_layer(..., act=SoftmaxActivation()) is already normalized
+    return getattr(input, "act", None) != "softmax"
+
+
+def regression_cost(input, label, name=None):
+    out = fl.mean(fl.square_error_cost(_var(input), _var(label)))
+    return _wrap(out, "cost", size=1, parents=[input, label])
+
+
+mse_cost = regression_cost
+
+
+def cross_entropy_cost(input, label, name=None):
+    out = fl.mean(fl.cross_entropy(_var(input), _var(label)))
+    return _wrap(out, "cost", size=1)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None):
+    helper = LayerHelper("sce")
+    iv, lv = _var(input), _var(label)
+    out = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [iv.name], "Label": [lv.name]},
+                     outputs={"Out": [out.name]})
+    return _wrap(fl.mean(out), "cost", size=1)
+
+
+def rank_cost(left, right, label, weight=None, name=None):
+    helper = LayerHelper("rank_loss")
+    out = helper.create_tmp_variable(_var(left).dtype, shape=(1,))
+    helper.append_op("rank_loss",
+                     inputs={"Left": [_var(left).name],
+                             "Right": [_var(right).name],
+                             "Label": [_var(label).name]},
+                     outputs={"Out": [out.name]})
+    return _wrap(fl.mean(out), "cost", size=1)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None):
+    helper = LayerHelper("huber_loss")
+    iv = _var(input)
+    out = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    resid = helper.create_tmp_variable(iv.dtype, shape=iv.shape)
+    helper.append_op("huber_loss",
+                     inputs={"X": [iv.name], "Y": [_var(label).name]},
+                     outputs={"Out": [out.name], "Residual": [resid.name]},
+                     attrs={"delta": float(delta)})
+    return _wrap(fl.mean(out), "cost", size=1)
+
+
+def crf_layer(input, label, param_attr=None, name=None):
+    """CRFLayer: linear-chain CRF negative log-likelihood."""
+    ll, _trans = fl.linear_chain_crf(_var(input), _var(label),
+                                     param_attr=to_param_attr(param_attr))
+    out = fl.mean(fl.scale(ll, scale=-1.0))
+    lo = _wrap(out, "crf", size=1, parents=[input, label])
+    lo.transition = _trans
+    return lo
+
+
+def crf_decoding_layer(input, transition, name=None):
+    out = fl.crf_decoding(_var(input), _var(transition))
+    return _wrap(out, "crf_decoding", parents=[input])
+
+
+def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
+              name=None):
+    """CTCLayer / warp_ctc_layer (layers.py ctc_layer): CTC loss over padded
+    logits + padded labels with companion lengths."""
+    helper = LayerHelper("warpctc")
+    iv, lv = _var(input), _var(label)
+    ilen = get_length_var(iv)
+    llen = get_length_var(lv)
+    loss = helper.create_tmp_variable(iv.dtype, shape=None)
+    grad = helper.create_tmp_variable(iv.dtype, shape=None)
+    helper.append_op(
+        "warpctc",
+        inputs={"Logits": [iv.name], "Label": [lv.name],
+                "LogitsLength": [ilen.name], "LabelLength": [llen.name]},
+        outputs={"Loss": [loss.name], "WarpCTCGrad": [grad.name]},
+        attrs={"blank": int(blank if blank is not None
+                            else (size or int(iv.shape[-1])) - 1)})
+    return _wrap(fl.mean(loss), "ctc", size=1)
+
+
+warp_ctc_layer = ctc_layer
+
+
+def nce_layer(input, label, num_classes, num_neg_samples=10, param_attr=None,
+              bias_attr=None, name=None):
+    helper = LayerHelper("nce", param_attr=to_param_attr(param_attr))
+    iv, lv = _var(input), _var(label)
+    D = int(iv.shape[-1])
+    w = helper.create_parameter(attr=to_param_attr(param_attr) or {},
+                                shape=[num_classes, D], dtype=iv.dtype)
+    b = helper.create_parameter(attr={}, shape=[num_classes], dtype=iv.dtype)
+    cost = helper.create_tmp_variable(iv.dtype, shape=None)
+    helper.append_op(
+        "nce",
+        inputs={"Input": [iv.name], "Label": [lv.name], "Weight": [w.name],
+                "Bias": [b.name]},
+        outputs={"Cost": [cost.name]},
+        attrs={"num_total_classes": int(num_classes),
+               "num_neg_samples": int(num_neg_samples)})
+    return _wrap(fl.mean(cost), "nce", size=1)
+
+
+def sum_cost(input, name=None):
+    helper = LayerHelper("reduce_sum")
+    iv = _var(input)
+    out = helper.create_tmp_variable(iv.dtype, shape=(1,))
+    helper.append_op("reduce_sum", inputs={"X": [iv.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"dim": None, "keep_dim": False})
+    return _wrap(out, "cost", size=1)
+
+
+# --- graph finalize ----------------------------------------------------------
+
+def outputs(*layers):
+    """Mark network outputs (config_parser outputs()).  Returns the fluid
+    Variables so callers can fetch them."""
+    return [_var(l) for l in layers]
+
+
+def parse_network(*outputs_) -> Program:
+    """The config_parser.parse_config equivalent: v1 configs built these
+    functions into a ModelConfig proto (config_parser.py:4345); here the
+    Program *is* the config — return it (serializable via
+    framework.proto_io)."""
+    return default_main_program()

@@ -1,0 +1,142 @@
+"""v1 network presets (reference trainer_config_helpers/networks.py:
+simple_img_conv_pool :144, vgg_16_network :547, simple_lstm, simple_gru
+:1076, bidirectional_gru/lstm :1226/:1310, simple_attention :1400)."""
+
+from __future__ import annotations
+
+from .. import layers as fl
+from ..framework.layer_helper import LayerHelper
+from .layers import get_length_var
+from .activations import LinearActivation, ReluActivation, TanhActivation, \
+    act_name
+from .layers import (LayerOutput, _apply_act, _var, _wrap, batch_norm_layer,
+                     concat_layer, fc_layer, grumemory, img_conv_layer,
+                     img_pool_layer, lstmemory, pooling_layer)
+from .poolings import MaxPooling
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride=None, act=None, pool_type=None,
+                         padding=None, **kw):
+    """conv + pool (networks.py:144)."""
+    conv = img_conv_layer(
+        input, filter_size=filter_size, num_filters=num_filters,
+        padding=padding if padding is not None else filter_size // 2, act=act)
+    return img_pool_layer(conv, pool_size=pool_size,
+                          stride=pool_stride or pool_size,
+                          pool_type=pool_type)
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3, pool_size=2,
+                   pool_stride=2, conv_act=None, conv_with_batchnorm=False,
+                   pool_type=None):
+    """Stacked convs + one pool (networks.py img_conv_group)."""
+    tmp = input
+    for nf in (conv_num_filter if isinstance(conv_num_filter, (list, tuple))
+               else [conv_num_filter]):
+        tmp = img_conv_layer(tmp, filter_size=conv_filter_size,
+                             num_filters=nf, padding=conv_filter_size // 2,
+                             act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = batch_norm_layer(tmp, act=conv_act)
+    return img_pool_layer(tmp, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type)
+
+
+def vgg_16_network(input_image, num_channels=3, num_classes=1000):
+    """VGG-16 (networks.py:547)."""
+    relu = ReluActivation()
+    tmp = input_image
+    for filters, convs in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
+        tmp = img_conv_group(tmp, [filters] * convs, conv_act=relu,
+                             conv_with_batchnorm=True)
+    from .activations import SoftmaxActivation
+
+    tmp = fc_layer(tmp, size=4096, act=relu)
+    tmp = fc_layer(tmp, size=4096, act=relu)
+    return fc_layer(tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def simple_lstm(input, size, reverse=False, act=None, **kw):
+    """fc(4H) + lstmemory (networks.py simple_lstm)."""
+    proj = fc_layer(input, size=size * 4)
+    return lstmemory(proj, size=size, reverse=reverse, act=act)
+
+
+def simple_gru(input, size, reverse=False, act=None, **kw):
+    """fc(3H) + grumemory (networks.py:1076)."""
+    proj = fc_layer(input, size=size * 3)
+    return grumemory(proj, size=size, reverse=reverse, act=act)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kw):
+    """Forward + backward lstm, concat (networks.py:1310)."""
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    f = pooling_layer(fwd, pooling_type=MaxPooling)
+    b = pooling_layer(bwd, pooling_type=MaxPooling)
+    return concat_layer([f, b])
+
+
+def bidirectional_gru(input, size, return_seq=False, **kw):
+    """networks.py:1226."""
+    fwd = simple_gru(input, size)
+    bwd = simple_gru(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    f = pooling_layer(fwd, pooling_type=MaxPooling)
+    b = pooling_layer(bwd, pooling_type=MaxPooling)
+    return concat_layer([f, b])
+
+
+def sequence_conv_pool(input, context_len, hidden_size, act=None,
+                       pool_type=None, **kw):
+    """Context-window conv + sequence pool (networks.py sequence_conv_pool,
+    the text-conv building block)."""
+    conv = fl.sequence_conv(_var(input), num_filters=hidden_size,
+                            filter_size=context_len)
+    conv = _apply_act(conv, act)
+    lo = _wrap(conv, "seq_conv", size=hidden_size, parents=[input])
+    return pooling_layer(lo, pooling_type=pool_type or MaxPooling)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None):
+    """Additive (Bahdanau) attention (networks.py:1400): score each encoder
+    step against the decoder state, softmax over true steps, weighted-sum
+    context.  The building block of the book NMT model."""
+    helper = LayerHelper("simple_attention")
+    enc = _var(encoded_sequence)   # [B, T, D]
+    proj = _var(encoded_proj)      # [B, T, A]
+    state = _var(decoder_state)    # [B, A]
+    lv = get_length_var(enc) or get_length_var(proj)
+    A = int(proj.shape[-1])
+    # broadcast decoder state over time: [B,1,A] + [B,T,A] (no static T)
+    state3 = fl.reshape(state, [-1, 1, A])
+    comb = fl.elementwise_add(proj, state3)
+    comb = _apply_act(comb, TanhActivation())
+    from .attrs import to_param_attr
+
+    scores = fl.sequence_fc(comb, size=1,
+                            param_attr=to_param_attr(transform_param_attr))
+    flat = helper.create_tmp_variable(scores.dtype, shape=None)
+    helper.append_op("squeeze", inputs={"X": [scores.name]},
+                     outputs={"Out": [flat.name]}, attrs={"axes": [-1]})
+    weights = helper.create_tmp_variable(scores.dtype, shape=None)
+    helper.append_op("sequence_softmax",
+                     inputs={"X": [flat.name], "Length": [lv.name]},
+                     outputs={"Out": [weights.name]})
+    wexp = helper.create_tmp_variable(scores.dtype, shape=None)
+    helper.append_op("unsqueeze", inputs={"X": [weights.name]},
+                     outputs={"Out": [wexp.name]}, attrs={"axes": [-1]})
+    # context = sum_t w_t * enc_t
+    weighted = fl.elementwise_mul(enc, wexp)
+    ctx = helper.create_tmp_variable(enc.dtype, shape=None)
+    helper.append_op("reduce_sum", inputs={"X": [weighted.name]},
+                     outputs={"Out": [ctx.name]},
+                     attrs={"dim": 1, "keep_dim": False})
+    return _wrap(ctx, "attention",
+                 size=getattr(encoded_sequence, "size", None))

@@ -66,13 +66,24 @@ def test_host_staging_reuses_buffers():
     from paddle_tpu.memory import HostStaging
 
     st = HostStaging()
-    a = st.stage(np.ones((8, 8), np.float32))
-    b = st.stage(np.zeros((8, 8), np.float32))
-    assert a is b  # same staging buffer reused
+    a = st.stage("x", np.ones((8, 8), np.float32))
+    b = st.stage("x", np.zeros((8, 8), np.float32))
+    assert a is b  # same slot: buffer reused across steps
     assert b[0, 0] == 0.0
-    assert st.nbytes() == 8 * 8 * 4
+    # distinct slots with identical shape/dtype must NOT alias
+    c = st.stage("y", np.full((8, 8), 3.0, np.float32))
+    assert c is not b and b[0, 0] == 0.0 and c[0, 0] == 3.0
+    assert st.nbytes() == 2 * 8 * 8 * 4
     st.clear()
     assert st.nbytes() == 0
+
+
+def test_synthetic_rng_deterministic():
+    from paddle_tpu.dataset.common import synthetic_rng
+
+    # crc32-based: stable across processes regardless of PYTHONHASHSEED
+    assert synthetic_rng("imdb").randint(1 << 30) == \
+        synthetic_rng("imdb").randint(1 << 30)
 
 
 def test_memory_copy_roundtrip():
