@@ -1,0 +1,155 @@
+"""Build + ctypes binding for the C inference API (capi.cc).
+
+Two consumption modes, both covered by tests:
+- in-process: load libpaddle_capi.so into this interpreter via ctypes — the
+  embedded-Python calls resolve into the already-running interpreter;
+- standalone: a C program links the library, calls paddle_capi_init() and
+  runs inference with no Python code of its own (the reference capi's
+  deployment story, capi/examples/model_inference)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "capi.cc")
+_LIB = os.path.join(_HERE, "libpaddle_capi.so")
+
+# single source of truth for the wire format: the runtime's table
+from ..capi_runtime import _DTYPE_CODES as DTYPE_CODES  # noqa: E402
+from ..capi_runtime import _DTYPES as CODE_DTYPES  # noqa: E402
+
+
+def python_build_flags() -> Tuple[List[str], List[str]]:
+    """(include_flags, link_flags) for embedding this interpreter."""
+    inc = [f"-I{sysconfig.get_path('include')}"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    link = []
+    if libdir:
+        link.append(f"-L{libdir}")
+    link.append(f"-lpython{ver}")
+    return inc, link
+
+
+def build_lib(force: bool = False) -> Optional[str]:
+    """g++ -shared over capi.cc (idempotent); None if toolchain missing."""
+    hdr = os.path.join(_HERE, "capi.h")
+    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(hdr))
+    if not force and os.path.exists(_LIB) and (
+            os.path.getmtime(_LIB) >= src_mtime):
+        return _LIB
+    inc, link = python_build_flags()
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB,
+             _SRC, *inc, *link],
+            check=True, capture_output=True, timeout=180)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+_dll = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _dll
+    if _dll is not None:
+        return _dll
+    path = build_lib()
+    if path is None:
+        return None
+    try:
+        dll = ctypes.CDLL(path, mode=ctypes.RTLD_GLOBAL)
+    except OSError:
+        return None
+    i64, i32, vp, cp = (ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
+                        ctypes.c_char_p)
+    dll.paddle_capi_init.restype = i32
+    dll.paddle_capi_init.argtypes = [cp]
+    dll.paddle_capi_last_error.restype = cp
+    dll.paddle_inference_create.restype = i32
+    dll.paddle_inference_create.argtypes = [cp, ctypes.POINTER(i64)]
+    dll.paddle_inference_set_input.restype = i32
+    dll.paddle_inference_set_input.argtypes = [
+        i64, cp, vp, ctypes.POINTER(i64), i32, i32]
+    dll.paddle_inference_run.restype = i32
+    dll.paddle_inference_run.argtypes = [i64, ctypes.POINTER(i32)]
+    dll.paddle_inference_output_shape.restype = i32
+    dll.paddle_inference_output_shape.argtypes = [
+        i64, i32, ctypes.POINTER(i64), i32, ctypes.POINTER(i32)]
+    dll.paddle_inference_output_dtype.restype = i32
+    dll.paddle_inference_output_dtype.argtypes = [i64, i32,
+                                                  ctypes.POINTER(i32)]
+    dll.paddle_inference_output_data.restype = i64
+    dll.paddle_inference_output_data.argtypes = [i64, i32, vp, i64]
+    dll.paddle_inference_release.restype = i32
+    dll.paddle_inference_release.argtypes = [i64]
+    _dll = dll
+    return dll
+
+
+class InferenceEngine:
+    """Pythonic shim over the C ABI (mirrors capi/examples usage)."""
+
+    def __init__(self, model_dir: str):
+        dll = load()
+        if dll is None:
+            raise RuntimeError("libpaddle_capi.so unavailable (no g++?)")
+        self._dll = dll
+        rc = dll.paddle_capi_init(None)
+        if rc != 0:
+            raise RuntimeError(dll.paddle_capi_last_error().decode())
+        h = ctypes.c_int64()
+        rc = dll.paddle_inference_create(model_dir.encode(),
+                                         ctypes.byref(h))
+        if rc != 0:
+            raise RuntimeError(dll.paddle_capi_last_error().decode())
+        self._h = h.value
+
+    def run(self, feeds: dict) -> List[np.ndarray]:
+        dll = self._dll
+        for name, arr in feeds.items():
+            arr = np.ascontiguousarray(arr)
+            code = DTYPE_CODES[arr.dtype]
+            shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            rc = dll.paddle_inference_set_input(
+                self._h, name.encode(),
+                arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim, code)
+            if rc != 0:
+                raise RuntimeError(dll.paddle_capi_last_error().decode())
+        n = ctypes.c_int()
+        rc = dll.paddle_inference_run(self._h, ctypes.byref(n))
+        if rc != 0:
+            raise RuntimeError(dll.paddle_capi_last_error().decode())
+        outs = []
+        for i in range(n.value):
+            shape = (ctypes.c_int64 * 16)()
+            rank = ctypes.c_int()
+            dll.paddle_inference_output_shape(self._h, i, shape, 16,
+                                              ctypes.byref(rank))
+            dcode = ctypes.c_int()
+            dll.paddle_inference_output_dtype(self._h, i,
+                                              ctypes.byref(dcode))
+            dims = [shape[j] for j in range(rank.value)]
+            dt = CODE_DTYPES[dcode.value]
+            buf = np.empty(dims, dtype=dt)
+            wrote = dll.paddle_inference_output_data(
+                self._h, i, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes)
+            if wrote < 0:
+                raise RuntimeError(dll.paddle_capi_last_error().decode())
+            outs.append(buf)
+        return outs
+
+    def close(self):
+        if self._h:
+            self._dll.paddle_inference_release(self._h)
+            self._h = 0

@@ -1,0 +1,138 @@
+"""C inference API tests (reference paddle/capi + capi/examples):
+in-process ctypes use, and a standalone C program embedding the runtime."""
+
+import os
+import subprocess
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    """Train a tiny regressor and save it as an inference model."""
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    w_target = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    pred = fluid.layers.fc(x, size=1)
+    label = fluid.layers.data("y", shape=[1], dtype="float32")
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    for _ in range(60):
+        xb = rng.randn(32, 4).astype(np.float32)
+        exe.run(feed={"x": xb, "y": xb @ w_target},
+                fetch_list=[cost])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    return d, w_target
+
+
+def test_capi_inprocess(saved_model):
+    from paddle_tpu.native.capi import InferenceEngine, load
+
+    if load() is None:
+        pytest.skip("g++ or libpython unavailable")
+    model_dir, w = saved_model
+    eng = InferenceEngine(model_dir)
+    x = np.array([[1.0, 0.0, 0.0, 0.0],
+                  [0.0, 1.0, 1.0, 2.0]], np.float32)
+    (out,) = eng.run({"x": x})
+    np.testing.assert_allclose(out, x @ w, atol=0.15)
+    # second run with new data reuses the engine
+    (out2,) = eng.run({"x": x * 2})
+    np.testing.assert_allclose(out2, 2 * x @ w, atol=0.3)
+    eng.close()
+
+
+def test_capi_error_reporting(saved_model):
+    from paddle_tpu.native.capi import InferenceEngine, load
+
+    if load() is None:
+        pytest.skip("g++ or libpython unavailable")
+    model_dir, _ = saved_model
+    eng = InferenceEngine(model_dir)
+    with pytest.raises(RuntimeError, match="unknown feed"):
+        eng.run({"bogus": np.zeros((1, 4), np.float32)})
+    eng.close()
+
+
+C_MAIN = r"""
+#include "capi.h"
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char** argv) {
+  if (paddle_capi_init(argv[2]) != 0) {
+    fprintf(stderr, "init: %s\n", paddle_capi_last_error());
+    return 2;
+  }
+  int64_t eng;
+  if (paddle_inference_create(argv[1], &eng) != 0) {
+    fprintf(stderr, "create: %s\n", paddle_capi_last_error());
+    return 3;
+  }
+  float x[8] = {1, 0, 0, 0, 0, 1, 1, 2};
+  int64_t shape[2] = {2, 4};
+  if (paddle_inference_set_input(eng, "x", x, shape, 2, PD_FLOAT32) != 0) {
+    fprintf(stderr, "set_input: %s\n", paddle_capi_last_error());
+    return 4;
+  }
+  int n_out = 0;
+  if (paddle_inference_run(eng, &n_out) != 0) {
+    fprintf(stderr, "run: %s\n", paddle_capi_last_error());
+    return 5;
+  }
+  int64_t oshape[8];
+  int rank = 0;
+  paddle_inference_output_shape(eng, 0, oshape, 8, &rank);
+  float out[16];
+  int64_t wrote = paddle_inference_output_data(eng, 0, out, sizeof(out));
+  if (wrote <= 0 || rank != 2 || oshape[0] != 2 || oshape[1] != 1) {
+    fprintf(stderr, "bad output geometry\n");
+    return 6;
+  }
+  printf("CAPI_OK %.3f %.3f\n", out[0], out[1]);
+  paddle_inference_release(eng);
+  if (paddle_capi_shutdown() != 0) return 7;
+  return 0;
+}
+"""
+
+
+def test_capi_standalone_c_program(saved_model, tmp_path):
+    """The real deployment path: a C binary with no Python of its own."""
+    from paddle_tpu.native.capi import build_lib, python_build_flags
+
+    lib = build_lib()
+    if lib is None:
+        pytest.skip("g++ or libpython unavailable")
+    model_dir, w = saved_model
+    src = tmp_path / "main.c"
+    src.write_text(C_MAIN)
+    exe_path = tmp_path / "capi_demo"
+    here = os.path.dirname(lib)
+    inc, link = python_build_flags()
+    # build_lib() already proved the toolchain works: a demo link failure
+    # here is a real ABI regression, not a missing-toolchain skip
+    r = subprocess.run(
+        ["g++", "-O2", str(src), "-o", str(exe_path), f"-I{here}",
+         f"-L{here}", "-lpaddle_capi", *inc, *link,
+         f"-Wl,-rpath,{here}"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"demo link failed:\n{r.stderr}"
+    repo_root = os.path.dirname(os.path.dirname(here))
+    env = dict(os.environ)
+    # the standalone binary must see paddle_tpu + run on CPU like the tests
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe_path), model_dir, repo_root],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "CAPI_OK" in r.stdout
+    vals = [float(v) for v in r.stdout.split()[1:3]]
+    expect = (np.array([[1, 0, 0, 0], [0, 1, 1, 2]], np.float32) @ w).ravel()
+    np.testing.assert_allclose(vals, expect, atol=0.2)
