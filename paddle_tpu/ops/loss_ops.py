@@ -302,3 +302,41 @@ def positive_negative_pair(ctx, ins, attrs):
     return {"PositivePair": [r(acc("AccumulatePositivePair", pos))],
             "NegativePair": [r(acc("AccumulateNegativePair", neg))],
             "NeutralPair": [r(acc("AccumulateNeutralPair", neu))]}
+
+
+@register_op("hsigmoid", non_diff_inputs=("Label",))
+def hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    gserver/layers/HierarchicalSigmoidLayer.cpp + math/MatrixBitCode):
+    cost of routing each sample to its label leaf, O(log C) parameters
+    touched per sample — here computed over the static max depth with
+    per-depth masks so the whole thing is a few MXU matmuls."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                      # [B, D]
+    w = ins["W"][0]                      # [C-1, D] internal-node weights
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)  # [B]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    import math
+
+    num_classes = int(attrs["num_classes"])
+    depth = max(int(math.ceil(math.log2(num_classes))), 1)
+
+    code = label + num_classes           # 1-indexed heap leaf position
+    losses = jnp.zeros(x.shape[0], x.dtype)
+    for k in range(1, depth + 1):
+        node = code >> k                 # ancestor (1-indexed internal node)
+        valid = node >= 1
+        idx = jnp.clip(node - 1, 0, num_classes - 2)
+        bit = (code >> (k - 1)) & 1      # 1 = right child
+        z = jnp.einsum("bd,bd->b", x, w[idx])
+        if bias is not None:
+            z = z + bias.reshape(-1)[idx]
+        # reference MatrixBitCode convention: loss = softplus(z) - bit*z,
+        # i.e. bit=1 → softplus(-z), bit=0 → softplus(z) — weights trained by
+        # the reference route identically here
+        t = 2.0 * bit.astype(x.dtype) - 1.0
+        losses = losses + jnp.where(valid, jax.nn.softplus(-t * z), 0.0)
+    return {"Out": [losses[:, None]]}

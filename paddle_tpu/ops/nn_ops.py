@@ -344,3 +344,38 @@ def row_conv(ctx, ins, attrs):
     pad = jnp.pad(x, ((0, 0), (0, ctx_len - 1), (0, 0)))
     out = sum(pad[:, i : i + x.shape[1]] * w[i] for i in range(ctx_len))
     return {"Out": [out]}
+
+
+@register_op("factorization_machine")
+def factorization_machine(ctx, ins, attrs):
+    """FM second-order interaction term (reference
+    gserver/layers/FactorizationMachineLayer.cpp):
+    0.5 * sum_k [ (x·V_k)^2 - (x^2)·(V_k^2) ] — two GEMMs on the MXU."""
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]      # [B, D]
+    v = ins["Factors"][0]    # [D, K] latent factors
+    xv = x @ v               # [B, K]
+    x2v2 = (x * x) @ (v * v)
+    out = 0.5 * jnp.sum(xv * xv - x2v2, axis=1, keepdims=True)
+    return {"Out": [out]}
+
+
+@register_op("selective_fc", non_diff_inputs=("Mask",))
+def selective_fc(ctx, ins, attrs):
+    """SelectiveFullyConnectedLayer (reference
+    gserver/layers/SelectiveFullyConnectedLayer.cpp): fc over a huge output
+    dimension where only selected columns matter.  The reference skips the
+    unselected columns' FLOPs on CPU; on TPU the full GEMM is one dense MXU
+    pass and selection becomes a mask on the result — same contract
+    (unselected outputs are 0 and carry no gradient), better hardware fit."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]          # [B, D]
+    w = ins["W"][0]          # [D, C]
+    out = x @ w
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    if ins.get("Mask") and ins["Mask"][0] is not None:
+        out = out * (ins["Mask"][0] != 0)
+    return {"Out": [out]}

@@ -603,3 +603,64 @@ def parse_network(*outputs_) -> Program:
     Program *is* the config — return it (serializable via
     framework.proto_io)."""
     return default_main_program()
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """HierarchicalSigmoidLayer (layers.py hsigmoid): O(log C) softmax
+    substitute for huge class counts."""
+    helper = LayerHelper("hsigmoid", param_attr=to_param_attr(param_attr))
+    iv, lv = _var(input), _var(label)
+    D = int(iv.shape[-1])
+    w = helper.create_parameter(attr=to_param_attr(param_attr) or {},
+                                shape=[num_classes - 1, D], dtype=iv.dtype)
+    inputs = {"X": [iv.name], "W": [w.name], "Label": [lv.name]}
+    if bias_attr is not False:  # False = no bias (v1 convention)
+        b = helper.create_parameter(attr=to_param_attr(bias_attr) or {},
+                                    shape=[num_classes - 1], dtype=iv.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b.name]
+    out = helper.create_tmp_variable(iv.dtype, shape=(iv.shape[0], 1))
+    helper.append_op(
+        "hsigmoid", inputs=inputs, outputs={"Out": [out.name]},
+        attrs={"num_classes": int(num_classes)})
+    return _wrap(fl.mean(out), "hsigmoid", size=1, parents=[input, label])
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None):
+    """FactorizationMachineLayer (layers.py factorization_machine)."""
+    helper = LayerHelper("fm", param_attr=to_param_attr(param_attr))
+    iv = _var(input)
+    D = int(iv.shape[-1])
+    v = helper.create_parameter(attr=to_param_attr(param_attr) or {},
+                                shape=[D, factor_size], dtype=iv.dtype)
+    out = helper.create_tmp_variable(iv.dtype, shape=(iv.shape[0], 1))
+    helper.append_op(
+        "factorization_machine",
+        inputs={"Input": [iv.name], "Factors": [v.name]},
+        outputs={"Out": [out.name]}, attrs={})
+    return _wrap(out, "factorization_machine", size=1, parents=[input])
+
+
+def selective_fc_layer(input, size, select=None, act=None, param_attr=None,
+                       bias_attr=None, name=None):
+    """SelectiveFullyConnectedLayer (layers.py selective_fc_layer)."""
+    helper = LayerHelper("selective_fc",
+                         param_attr=to_param_attr(param_attr))
+    iv = _var(input)
+    D = int(iv.shape[-1])
+    w = helper.create_parameter(attr=to_param_attr(param_attr) or {},
+                                shape=[D, size], dtype=iv.dtype)
+    out = helper.create_tmp_variable(iv.dtype, shape=(iv.shape[0], size))
+    inputs = {"X": [iv.name], "W": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=to_param_attr(bias_attr) or {},
+                                    shape=[size], dtype=iv.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b.name]
+    if select is not None:
+        inputs["Mask"] = [_var(select).name]
+    helper.append_op("selective_fc", inputs=inputs,
+                     outputs={"Out": [out.name]}, attrs={})
+    return _wrap(_apply_act(out, act), "selective_fc", size=size,
+                 parents=[input])

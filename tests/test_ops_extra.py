@@ -182,3 +182,52 @@ def test_print_op_identity(capfd):
     x = _r(2, 2)
     t = OpTestHarness("print", {"X": x}, {"message": "dbg: "})
     t.check_output({"Out": x})
+
+
+def test_hsigmoid_cost_and_grad():
+    B, D, C = 4, 6, 5
+    x = _r(B, D)
+    w = _r(C - 1, D) * 0.3
+    bias = _r(C - 1) * 0.1
+    label = np.array([0, 2, 4, 1], np.int64).reshape(-1, 1)
+    t = OpTestHarness("hsigmoid",
+                      {"X": x, "W": w, "Label": label, "Bias": bias},
+                      {"num_classes": C})
+    # numpy reference: walk the heap path of each label leaf
+    import math
+    depth = max(int(math.ceil(math.log2(C))), 1)
+    want = np.zeros((B, 1))
+    for i in range(B):
+        code = int(label[i, 0]) + C
+        for k in range(1, depth + 1):
+            node = code >> k
+            if node < 1:
+                continue
+            z = x[i] @ w[node - 1] + bias[node - 1]
+            bit = (code >> (k - 1)) & 1
+            # reference form: softplus(z) - bit*z
+            want[i, 0] += np.log1p(np.exp(z)) - bit * z
+    t.check_output({"Out": want}, atol=1e-6)
+    t.check_grad(["X", "W"])
+
+
+def test_factorization_machine():
+    x, v = _r(3, 5), _r(5, 2)
+    t = OpTestHarness("factorization_machine",
+                      {"Input": x, "Factors": v})
+    xv = x @ v
+    want = 0.5 * np.sum(xv * xv - (x * x) @ (v * v), axis=1, keepdims=True)
+    t.check_output({"Out": want})
+    t.check_grad(["Input", "Factors"])
+
+
+def test_selective_fc_masks_outputs():
+    x, w, b = _r(2, 4), _r(4, 6), _r(6)
+    mask = np.zeros((2, 6))
+    mask[0, [1, 3]] = 1
+    mask[1, [0, 5]] = 1
+    t = OpTestHarness("selective_fc",
+                      {"X": x, "W": w, "Bias": b, "Mask": mask})
+    want = (x @ w + b) * mask
+    t.check_output({"Out": want})
+    t.check_grad(["X", "W"])

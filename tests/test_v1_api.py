@@ -176,3 +176,49 @@ def test_v1_simple_attention_runs():
     # row 0 mixes identical vectors 1.0 → context == 1.0
     np.testing.assert_allclose(out[0], np.ones(6), atol=1e-5)
     np.testing.assert_allclose(out[1], 2 * np.ones(6), atol=1e-5)
+
+
+def test_v1_hsigmoid_and_fm_train():
+    from paddle_tpu.v1 import factorization_machine, hsigmoid
+
+    settings(learning_rate=5e-2, learning_method=AdamOptimizer())
+    x = data_layer("hx", size=8)
+    label = data_layer("hl", size=1, dtype="int64")
+    hcost = hsigmoid(x, label, num_classes=6)
+    fm = factorization_machine(x, factor_size=3)
+    total = mse_cost(fm, data_layer("ht", size=1))
+    # optimize both costs jointly via sum
+    from paddle_tpu import layers as fl2
+
+    joint = fl2.elementwise_add(hcost.var, total.var)
+    opt = optimizer_from_settings()
+    opt.minimize(joint)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(5)
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = rng.randint(0, 6, (16, 1)).astype(np.int64)
+    ts = (xs[:, :1] * xs[:, 1:2]).astype(np.float32)
+    losses = []
+    for _ in range(10):
+        (l,) = exe.run(feed={"hx": xs, "hl": ys, "ht": ts},
+                       fetch_list=[joint])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_v1_selective_fc():
+    from paddle_tpu.v1 import selective_fc_layer
+
+    x = data_layer("sx", size=4)
+    sel = data_layer("ssel", size=10)
+    out = selective_fc_layer(x, size=10, select=sel)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(6)
+    mask = np.zeros((2, 10), np.float32)
+    mask[:, :3] = 1
+    (o,) = exe.run(feed={"sx": rng.randn(2, 4).astype(np.float32),
+                         "ssel": mask}, fetch_list=[out.var])
+    assert o.shape == (2, 10)
+    assert np.all(o[:, 3:] == 0) and np.any(o[:, :3] != 0)
