@@ -48,7 +48,9 @@ def main():
     from paddle_tpu.models import resnet
 
     model = os.environ.get("BENCH_MODEL", "resnet")
-    batch_size = int(os.environ.get("BENCH_BS", "64"))
+    # bs128 is the single-chip sweet spot on v5e: ~2230 img/s vs ~1890 at
+    # bs64 (measured 2026-07; bs96/160/192/256 all slower)
+    batch_size = int(os.environ.get("BENCH_BS", "128"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))

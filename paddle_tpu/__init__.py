@@ -30,6 +30,7 @@ from . import evaluator  # noqa: F401
 from . import profiler  # noqa: F401
 from . import learning_rate_decay  # noqa: F401
 from . import memory  # noqa: F401
+from . import net_drawer  # noqa: F401
 from . import reader  # noqa: F401
 from .data_feeder import DataFeeder, DeviceFeeder  # noqa: F401
 from .lod import LoDTensor  # noqa: F401

@@ -15,6 +15,7 @@ from .. import optimizer  # noqa: F401
 from .. import reader  # noqa: F401
 from ..reader import batch  # noqa: F401
 from . import event  # noqa: F401
+from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
 from .parameters import Parameters  # noqa: F401
 from .trainer import SGD, infer  # noqa: F401

@@ -138,3 +138,34 @@ def test_save_load_persistables_roundtrip(tmp_path):
     (after,) = exe2.run(eval_prog, feed={"x": xs, "y": ys},
                         fetch_list=[loss])
     np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_net_drawer_emits_dot():
+    import paddle_tpu as fluid
+    from paddle_tpu import net_drawer
+
+    fluid.reset()
+    x = fluid.layers.data("nd_x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, size=2, act="relu")
+    dot = net_drawer.draw_graph()
+    assert dot.startswith("digraph")
+    assert '"op_0" [label="mul"' in dot
+    assert "relu" in dot and "nd_x" in dot
+    assert dot.rstrip().endswith("}")
+
+
+def test_v2_ploter_collects_and_renders(tmp_path):
+    from paddle_tpu.v2.plot import Ploter
+
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+        p.append("test", i, 2.0 / (i + 1))
+    assert p.__plot_data__["train"].value[0] == 1.0
+    out = p.plot(str(tmp_path / "curve.png"))
+    if out is not None:  # matplotlib present
+        import os
+
+        assert os.path.getsize(out) > 0
+    p.reset()
+    assert p.__plot_data__["train"].step == []
