@@ -36,3 +36,13 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
             f"mesh {axes} needs {total} devices, have {len(devices)}")
     arr = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(arr, axis_names=names)
+
+
+def get_shard_map():
+    """Version-portable shard_map import (moved to jax.* in 0.8)."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+        return shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        return shard_map

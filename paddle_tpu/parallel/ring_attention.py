@@ -86,10 +86,9 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     the sequence axis sharded over `axis_name`."""
     import jax
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from .mesh import get_shard_map
+
+    shard_map = get_shard_map()
     from jax.sharding import PartitionSpec as P
 
     d = q.shape[-1]

@@ -111,3 +111,60 @@ def test_embedding_vocab_sharded():
     assert np.isfinite(l).all()
     w = fluid.global_scope().find("embedding_0.w_0")
     assert tuple(w.sharding.spec) == ("mp", None), w.sharding.spec
+
+
+def test_pipeline_parallel_trains():
+    """GPipe-style pp over the virtual mesh: loss must drop and match a
+    single-device serial reference on the first step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.pipeline import (build_pipeline_train_step,
+                                              init_pipeline_params)
+
+    pp, dp, width, n_micro = 4, 2, 16, 4
+    mesh = make_mesh({"pp": pp, "dp": dp})
+    params = init_pipeline_params(jax.random.PRNGKey(0), pp, width)
+    step, shard = build_pipeline_train_step(mesh, n_micro=n_micro,
+                                            width=width, lr=0.2)
+    params = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, shard), params)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, width).astype(np.float32)
+    y = np.tanh(x @ rng.randn(width, width).astype(np.float32) * 0.3)
+    losses = []
+    for _ in range(12):
+        loss, params = step(params, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+
+    # serial reference for step-0 loss: apply stages in order
+    p0 = init_pipeline_params(jax.random.PRNGKey(0), pp, width)
+    h = x
+    for s in range(pp):
+        h = np.tanh(h @ np.asarray(p0["w"][s]) + np.asarray(p0["b"][s]))
+    ref = float(np.mean((h - y) ** 2))
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+
+
+def test_moe_expert_parallel_trains():
+    """Top-1 MoE with all_to_all over ep: loss drops; capacity bound holds."""
+    import jax
+    import numpy as np
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.moe import build_moe_train_step, init_moe_params
+
+    ep, dp, D, H = 4, 2, 8, 16
+    mesh = make_mesh({"ep": ep, "dp": dp})
+    params = init_moe_params(jax.random.PRNGKey(1), ep, D, H)
+    step = build_moe_train_step(mesh, d_model=D, d_hidden=H, capacity=16)
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, D).astype(np.float32)
+    y = (x * 2.0 + 0.5).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        loss, params = step(params, x, y)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8
