@@ -48,9 +48,11 @@ def main():
     from paddle_tpu.models import resnet
 
     model = os.environ.get("BENCH_MODEL", "resnet")
-    # bs128 is the single-chip sweet spot on v5e: ~2230 img/s vs ~1890 at
-    # bs64 (measured 2026-07; bs96/160/192/256 all slower)
-    batch_size = int(os.environ.get("BENCH_BS", "128"))
+    # resnet: bs128 is the single-chip sweet spot on v5e (~2230 img/s vs
+    # ~1890 at bs64; bs96/160/192/256 all slower, measured 2026-07).
+    # lstm: keep the baseline-comparable bs64 (K40m reference is bs64).
+    batch_size = int(os.environ.get(
+        "BENCH_BS", "64" if model == "lstm" else "128"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))

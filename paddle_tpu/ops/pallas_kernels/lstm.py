@@ -1,0 +1,133 @@
+"""Fused LSTM time-loop as a Pallas TPU kernel.
+
+The second custom-fusion tier item from SURVEY.md §2.10 (the reference's
+hand-written hl_gpu_lstm.cuh / lstm_gpu_kernel.h): one kernel runs the whole
+recurrence, keeping h/c state and the recurrent weight resident in VMEM
+across timesteps instead of round-tripping HBM every step the way a lowered
+`lax.scan` must for its carries.
+
+Layout: time-major. The TPU Pallas grid is sequential, so grid=(T,) with
+VMEM scratch for (h, c) implements the scan; per step one [B,H]x[H,4H] MXU
+GEMM + VPU gate math. Gate order matches operators/lstm_op.cc: i, f, c̃, o.
+
+Used on the inference path (forward only); training keeps the differentiable
+`lax.scan` form so desc-level autodiff is untouched.
+"""
+
+from __future__ import annotations
+
+
+def _vmem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM
+
+
+def _kernel(x_ref, m_ref, h0_ref, c0_ref, w_ref, hs_ref, cs_ref, hT_ref,
+            cT_ref, h_sc, c_sc):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_sc[...] = h0_ref[...].astype(jnp.float32)
+        c_sc[...] = c0_ref[...].astype(jnp.float32)
+
+    h = h_sc[...]
+    c = c_sc[...]
+    x_t = x_ref[0]          # [B, 4H] pre-projected input for this step
+    w = w_ref[...]          # [H, 4H] recurrent weight, VMEM-resident
+    H = w.shape[0]
+
+    gates = x_t.astype(jnp.float32) + jax.lax.dot_general(
+        h.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, :H])
+    f = jax.nn.sigmoid(gates[:, H:2 * H])
+    cand = jnp.tanh(gates[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(gates[:, 3 * H:])
+    c_new = f * c + i * cand
+    h_new = o * jnp.tanh(c_new)
+
+    # mask is VMEM-resident whole ([T,B]); dynamic-slice this step's row
+    m = m_ref[pl.ds(t, 1), :].astype(jnp.float32).reshape(-1, 1)  # [B,1]
+    h_new = m * h_new + (1.0 - m) * h
+    c_new = m * c_new + (1.0 - m) * c
+    h_sc[...] = h_new
+    c_sc[...] = c_new
+    hs_ref[0] = h_new.astype(hs_ref.dtype)
+    cs_ref[0] = c_new.astype(cs_ref.dtype)
+
+    @pl.when(t == T - 1)
+    def _final():
+        hT_ref[...] = h_new.astype(hT_ref.dtype)
+        cT_ref[...] = c_new.astype(cT_ref.dtype)
+
+
+def lstm_forward(x_proj, h0, c0, w, lengths, interpret: bool = False):
+    """x_proj [B,T,4H] (input projection + bias already applied), h0/c0
+    [B,H], w [H,4H], lengths [B] → (hs [B,T,H], cs [B,T,H], hT, cT)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+    xt = jnp.moveaxis(x_proj, 1, 0)   # [T, B, 4H] time-major
+    mt = mask.T                        # [T, B]
+
+    hs, cs, hT, cT = pl.pallas_call(
+        _kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((T, B), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), x_proj.dtype),
+            jax.ShapeDtypeStruct((T, B, H), x_proj.dtype),
+            jax.ShapeDtypeStruct((B, H), x_proj.dtype),
+            jax.ShapeDtypeStruct((B, H), x_proj.dtype),
+        ],
+        scratch_shapes=[
+            _vmem()((B, H), jnp.float32),
+            _vmem()((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt, mt, h0, c0, w)
+    return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1), hT, cT
+
+
+def usable(x_proj, attrs) -> bool:
+    """Kernel constraints: default activations, lane-friendly H, and the
+    whole weight + one step fitting VMEM comfortably."""
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    if attrs.get("gate_activation", "sigmoid") != "sigmoid":
+        return False
+    if attrs.get("cell_activation", "tanh") != "tanh":
+        return False
+    if attrs.get("candidate_activation", "tanh") != "tanh":
+        return False
+    if bool(attrs.get("is_reverse", False)):
+        return False
+    if H % 128 != 0 or B % 8 != 0:
+        return False
+    # VMEM budget (f32): w + x_t + 2*state + hs_t + the WHOLE [T,B] mask
+    # (kept resident — see the constant-index BlockSpec); stay under ~8MB
+    step_bytes = 4 * (H * H4 + B * H4 + 3 * B * H + T * B)
+    return step_bytes < 8 * 1024 * 1024

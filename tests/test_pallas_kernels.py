@@ -25,3 +25,53 @@ def test_flash_attention_block_not_dividing_raises():
     q = np.zeros((1, 1, 60, 16), np.float32)
     with pytest.raises(AssertionError):
         flash_attention(q, q, q, block_q=16, block_k=16, interpret=True)
+
+
+def test_pallas_lstm_matches_scan_reference():
+    """Fused LSTM time-loop kernel vs step-by-step numpy (interpret mode)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels.lstm import lstm_forward, usable
+
+    B, T, H = 8, 6, 128
+    rng = np.random.RandomState(0)
+    x = (rng.randn(B, T, 4 * H) * 0.3).astype(np.float32)
+    w = (rng.randn(H, 4 * H) * 0.1).astype(np.float32)
+    h0 = np.zeros((B, H), np.float32)
+    c0 = np.zeros((B, H), np.float32)
+    lengths = np.array([6, 6, 4, 6, 2, 6, 6, 5], np.int32)
+    assert usable(x, {})
+
+    hs, cs, hT, cT = lstm_forward(jnp.asarray(x), jnp.asarray(h0),
+                                  jnp.asarray(c0), jnp.asarray(w),
+                                  jnp.asarray(lengths), interpret=True)
+
+    h, c = h0.copy(), c0.copy()
+    out = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        g = x[:, t] + h @ w
+        i = 1 / (1 + np.exp(-g[:, :H]))
+        f = 1 / (1 + np.exp(-g[:, H:2 * H]))
+        cand = np.tanh(g[:, 2 * H:3 * H])
+        o = 1 / (1 + np.exp(-g[:, 3 * H:]))
+        cn = f * c + i * cand
+        hn = o * np.tanh(cn)
+        m = (t < lengths).astype(np.float32)[:, None]
+        h, c = m * hn + (1 - m) * h, m * cn + (1 - m) * c
+        out[:, t] = h
+    np.testing.assert_allclose(np.asarray(hs), out, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(cs)[:, -1], c, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(hT), h, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(cT), c, atol=5e-4)
+
+
+def test_pallas_lstm_usable_gate():
+    import numpy as np
+    from paddle_tpu.ops.pallas_kernels.lstm import usable
+
+    x = np.zeros((8, 4, 512), np.float32)
+    assert usable(x, {})
+    assert not usable(x, {"is_reverse": True})
+    assert not usable(x, {"gate_activation": "tanh"})
+    assert not usable(np.zeros((7, 4, 512), np.float32), {})  # B % 8
+    assert not usable(np.zeros((8, 4, 4 * 100), np.float32), {})  # H % 128
