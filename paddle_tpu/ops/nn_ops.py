@@ -56,48 +56,118 @@ def conv2d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
+    # paddle filter layout is [C_in, C_out, H, W]; with transpose_kernel=True
+    # jax swaps the I/O roles of the rhs spec, so the spec names the
+    # TRANSPOSED reading: "O"=C_in (must match input), "I"=C_out.
+    # padding: paddle gives the FORWARD conv's pad p; the transposed conv
+    # needs d*(k-1)-p so out = (in-1)*s - 2p + d*(k-1) + 1 (conv_transpose_op.h)
+    jpad = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
+            for i in range(2)]
     out = jax.lax.conv_transpose(
         x,
         w,
         strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=jpad,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
     )
     return {"Output": [out]}
 
 
-@register_op("pool2d")
-def pool2d(ctx, ins, attrs):
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)] * 3
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    """Volumetric conv (reference conv_op.cc:321 conv3d; vol2col collapses
+    into the XLA convolution)."""
+    import jax
+
+    x = ins["Input"][0]  # NCDHW
+    w = ins["Filter"][0]  # OIDHW
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dilations = _triple(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    return {"Output": [out]}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx, ins, attrs):
+    """Reference conv_transpose_op.cc:312."""
+    import jax
+
+    x = ins["Input"][0]
+    w = ins["Filter"][0]  # IODHW
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dilations = _triple(attrs.get("dilations", [1, 1, 1]))
+    # see conv2d_transpose: spec + padding are the transposed reading of the
+    # [C_in, C_out, D, H, W] paddle filter layout
+    jpad = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
+            for i in range(3)]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=jpad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+def _pool_nd(x, attrs, ndim):
+    """Shared max/avg window pooling over the trailing `ndim` spatial dims
+    (pool_op.cc pool2d/pool3d common path)."""
     import jax
     import jax.numpy as jnp
 
-    x = ins["X"][0]  # NCHW
+    tup = _pair if ndim == 2 else _triple
     ptype = attrs.get("pooling_type", "max")
-    ksize = _pair(attrs.get("ksize", [2, 2]))
-    strides = _pair(attrs.get("strides", ksize))
-    pads = _pair(attrs.get("paddings", [0, 0]))
+    ksize = tup(attrs.get("ksize", [2] * ndim))
+    strides = tup(attrs.get("strides", ksize))
+    pads = tup(attrs.get("paddings", [0] * ndim))
     if attrs.get("global_pooling", False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = list(x.shape[2:])
         strides = ksize
-        pads = [0, 0]
-    window = (1, 1, ksize[0], ksize[1])
-    strides4 = (1, 1, strides[0], strides[1])
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+        pads = [0] * ndim
+    window = (1, 1) + tuple(ksize)
+    stridesn = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
     if ptype == "max":
-        init = -jnp.inf
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
-    else:
-        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
-        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
-            ones = jnp.ones_like(x)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4,
-                                        padding)
-            out = out / cnt
-        else:
-            out = out / (ksize[0] * ksize[1])
-    return {"Out": [out]}
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                     stridesn, padding)
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stridesn,
+                                padding)
+    if attrs.get("exclusive", True) and any(pads):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, stridesn, padding)
+        return out / cnt
+    denom = 1
+    for k in ksize:
+        denom *= k
+    return out / denom
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    """Reference pool_op.cc:298 pool3d (max/avg over NCDHW windows)."""
+    return {"Out": [_pool_nd(ins["X"][0], attrs, 3)]}
+
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    """Reference pool_op.cc pool2d — shares _pool_nd with pool3d."""
+    return {"Out": [_pool_nd(ins["X"][0], attrs, 2)]}
 
 
 @register_op("batch_norm", non_diff_outputs=("MeanOut", "VarianceOut",

@@ -231,3 +231,98 @@ def test_selective_fc_masks_outputs():
     want = (x @ w + b) * mask
     t.check_output({"Out": want})
     t.check_grad(["X", "W"])
+
+
+def test_conv3d():
+    x = _r(1, 2, 4, 4, 4)
+    w = _r(3, 2, 3, 3, 3)
+    t = OpTestHarness("conv3d", {"Input": x, "Filter": w},
+                      {"strides": [1, 1, 1], "paddings": [1, 1, 1]},
+                      out_slots=["Output"])
+    (out,) = t.fetch()
+    assert out.shape == (1, 3, 4, 4, 4)
+    # spot-check center voxel against direct correlation
+    want = (x[0, :, 0:3, 0:3, 0:3] * w[1]).sum()
+    np.testing.assert_allclose(out[0, 1, 1, 1, 1], want, rtol=1e-6)
+    t.check_grad(["Input", "Filter"], output_slot="Output")
+
+
+def test_conv3d_transpose_values():
+    """Value-level check incl. C_in != C_out (the layout-swap hazard class
+    caught in conv2d_transpose): stride-1 pad-0 transposed conv = scatter-add
+    of kernel copies."""
+    x = _r(1, 3, 2, 2, 2)
+    w = _r(3, 2, 2, 2, 2)  # [C_in=3, C_out=2, ...]
+    t = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                      {"strides": [1, 1, 1]}, out_slots=["Output"])
+    (out,) = t.fetch()
+    assert out.shape == (1, 2, 3, 3, 3)
+    want = np.zeros((1, 2, 3, 3, 3))
+    for ci in range(3):
+        for co in range(2):
+            for d in range(2):
+                for i in range(2):
+                    for j in range(2):
+                        want[0, co, d:d+2, i:i+2, j:j+2] += \
+                            x[0, ci, d, i, j] * w[ci, co]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    t.check_grad(["Input", "Filter"], output_slot="Output")
+
+
+def test_conv3d_transpose_stride_dilation_shape():
+    x = _r(1, 3, 2, 2, 2)
+    w = _r(3, 2, 3, 3, 3)
+    t = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                      {"strides": [2, 2, 2]}, out_slots=["Output"])
+    (out,) = t.fetch()
+    assert out.shape == (1, 2, 5, 5, 5)  # (2-1)*2 + (3-1) + 1
+    td = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                       {"strides": [1, 1, 1], "dilations": [2, 2, 2]},
+                       out_slots=["Output"])
+    (outd,) = td.fetch()
+    assert outd.shape == (1, 2, 6, 6, 6)  # (2-1)*1 + 2*(3-1) + 1
+
+
+def test_pool3d():
+    x = _r(1, 1, 4, 4, 4)
+    t = OpTestHarness("pool3d", {"X": x},
+                      {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                       "pooling_type": "max"})
+    want = np.zeros((1, 1, 2, 2, 2))
+    for d in range(2):
+        for i in range(2):
+            for j in range(2):
+                want[0, 0, d, i, j] = x[0, 0, 2*d:2*d+2,
+                                        2*i:2*i+2, 2*j:2*j+2].max()
+    t.check_output({"Out": want})
+    t.check_grad(["X"])
+    ta = OpTestHarness("pool3d", {"X": x},
+                       {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                        "pooling_type": "avg"})
+    wavg = np.zeros((1, 1, 2, 2, 2))
+    for d in range(2):
+        for i in range(2):
+            for j in range(2):
+                wavg[0, 0, d, i, j] = x[0, 0, 2*d:2*d+2,
+                                        2*i:2*i+2, 2*j:2*j+2].mean()
+    ta.check_output({"Out": wavg})
+
+
+def test_conv2d_transpose_rect_channels():
+    """C_in != C_out regression: paddle filter layout [C_in, C_out, H, W]
+    must map correctly through jax's transpose_kernel semantics; numpy
+    reference = gradient-of-conv (stride-1, pad-0 full correlation)."""
+    x = _r(1, 3, 3, 3)
+    w = _r(3, 2, 2, 2)  # C_in=3, C_out=2
+    t = OpTestHarness("conv2d_transpose", {"Input": x, "Filter": w},
+                      {"strides": [1, 1]}, out_slots=["Output"])
+    (out,) = t.fetch()
+    assert out.shape == (1, 2, 4, 4)
+    want = np.zeros((1, 2, 4, 4))
+    for ci in range(3):
+        for co in range(2):
+            for i in range(3):
+                for j in range(3):
+                    want[0, co, i:i+2, j:j+2] += x[0, ci, i, j] * w[ci, co]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    t.check_grad(["Input", "Filter"], output_slot="Output")
