@@ -45,6 +45,10 @@ def build_callable(program, fetch_list, scope=None, feed_names=None,
         ctx.lower_block = lambda idx, sub_env: _lower_ops(
             program.blocks[idx].ops, sub_env, ctx)
         _lower_ops(block.ops, env, ctx)
+        if ctx.host_saves:
+            raise NotImplementedError(
+                "save ops require Executor.run (its post-step host write); "
+                "compiler.build_callable has no host side")
         return {n: env[n] for n in fetch_names}
 
     return fn, state

@@ -35,13 +35,21 @@ logger = logging.getLogger("paddle_tpu")
 _NOOP_TYPES = ("feed", "fetch")
 
 
+_SAVE_PREFIX = "__save__"
+
+
 class _Compiled:
-    def __init__(self, fn, external_reads, rw_state, written_state, fetch_names):
+    def __init__(self, fn, external_reads, rw_state, written_state, fetch_names,
+                 save_specs=()):
         self.fn = fn
         self.external_reads = external_reads  # read-only state var names
         self.rw_state = rw_state  # read-then-written: must pre-exist, donated
         self.written_state = written_state  # all names persisted back to scope
         self.fetch_names = fetch_names
+        # (path, overwrite) per `save` op in the block, written post-step.
+        # NOTE: kept by reference — the list is filled as a trace-time side
+        # effect on the first fn() call, after this object is constructed
+        self.save_specs = save_specs
 
 
 def _fetch_name(f) -> str:
@@ -126,6 +134,20 @@ class Executor:
             fetches, new_state = compiled.fn(state_w, state_r, feed_vals, rng)
         for n, v in new_state.items():
             scope.set(n, v)
+        if compiled.save_specs:
+            import os
+
+            for i, (path, overwrite) in enumerate(compiled.save_specs):
+                if os.path.exists(path) and not overwrite:
+                    raise IOError(
+                        f"save op: {path!r} exists and overwrite=False "
+                        f"(save_op.cc semantics)")
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                # write through a file object: np.save(path) would append
+                # ".npy" to extension-less reference-style paths
+                with open(path, "wb") as f:
+                    np.save(f, np.asarray(fetches[f"{_SAVE_PREFIX}{i}"]),
+                            allow_pickle=False)
         if self.check_nan_inf:
             # FLAGS_check_nan_inf analog (reference executor.cc:26,120-128):
             # scan fetches + updated state for non-finite values
@@ -228,17 +250,32 @@ class Executor:
             for op in block.ops
         )
 
+        # populated as a trace-time side effect of the first run (covers
+        # `save` ops in nested blocks too)
+        save_specs: List[tuple] = []
+
         def step_fn(state_w, state_r, feeds, rng_key):
             env = {}
             env.update(state_r)
             env.update(state_w)
             env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
             ctx = EmitContext(rng_key, is_test=is_test, program=program)
-            ctx.lower_block = lambda idx, sub_env: _lower_ops(
-                program.blocks[idx].ops, sub_env, ctx
-            )
+
+            def lower_sub(idx, sub_env):
+                ctx.sub_depth += 1
+                try:
+                    return _lower_ops(program.blocks[idx].ops, sub_env, ctx)
+                finally:
+                    ctx.sub_depth -= 1
+
+            ctx.lower_block = lower_sub
             _lower_ops(block.ops, env, ctx)
             fetches = {n: env[n] for n in fetch_names}
+            # `save` ops: their traced values leave the program as reserved
+            # fetches; Executor.run writes the files after the step
+            save_specs[:] = [(p, o) for p, o, _ in ctx.host_saves]
+            for i, (_, _, val) in enumerate(ctx.host_saves):
+                fetches[f"{_SAVE_PREFIX}{i}"] = val
             new_state = {n: env[n] for n in written_state if n in env}
             return fetches, new_state
 
@@ -249,7 +286,7 @@ class Executor:
             feed_names,
         )
         return _Compiled(jitted, external_reads, rw_state, written_state,
-                         fetch_names)
+                         fetch_names, save_specs)
 
     def close(self):
         self._cache.clear()

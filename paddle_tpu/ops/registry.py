@@ -92,6 +92,13 @@ class EmitContext:
         # callable(block_idx, env) -> env  provided by the executor so control
         # flow ops can lower nested blocks
         self.lower_block = lower_block
+        # (path, overwrite) per `save` op, in op order; the executor fetches
+        # the paired traced values and writes the files after the step (host
+        # callbacks inside the program don't exist on all PJRT backends)
+        self.host_saves = []
+        # >0 while lowering a control-flow sub-block (while/cond body): ops
+        # whose values must escape to the host (save) cannot live there
+        self.sub_depth = 0
 
     def rng(self, attrs) -> "object":
         """Deterministic per-op PRNG key: base key folded with the op's uid.

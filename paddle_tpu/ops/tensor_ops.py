@@ -305,3 +305,34 @@ def print_op(ctx, ins, attrs):
 @register_op("increment")
 def increment(ctx, ins, attrs):
     return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register_op("save", grad=None)
+def save_op(ctx, ins, attrs):
+    """Tensor checkpoint as a graph op (reference save_op.cc:59): the traced
+    value rides out of the compiled step as a reserved fetch; the executor
+    writes `file_path` right after the step completes.  (io_callback would
+    put the write inside the program, but host callbacks are not available
+    on every PJRT backend — e.g. tunneled TPUs.)"""
+    if getattr(ctx, "sub_depth", 0) > 0:
+        raise NotImplementedError(
+            "save op inside a control-flow sub-block: its value cannot "
+            "escape the traced while/cond body to the host")
+    x = ins["X"][0]
+    ctx.host_saves.append((str(attrs["file_path"]),
+                           bool(attrs.get("overwrite", True)), x))
+    return {}
+
+
+@register_op("load", grad=None)
+def load_op(ctx, ins, attrs):
+    """Tensor restore as a graph op (reference load_op.cc:22).  The file is
+    read when the program is compiled (first run) and embedded as a constant
+    — the reference's usage pattern (load programs run once at startup)."""
+    jnp = _j()
+    path = str(attrs["file_path"])
+    with open(path, "rb") as f:  # exact path — np.load would accept it too
+        arr = np.load(f, allow_pickle=False)
+    if attrs.get("dtype"):
+        arr = arr.astype(np_dtype(attrs["dtype"]), copy=False)
+    return {"Out": [jnp.asarray(arr)]}

@@ -139,6 +139,10 @@ class ParallelExecutor(Executor):
             ctx.lower_block = lambda idx, sub_env: _lower_ops(
                 program.blocks[idx].ops, sub_env, ctx)
             _lower_ops(block.ops, env, ctx)
+            if ctx.host_saves:
+                raise NotImplementedError(
+                    "save ops are not supported under ParallelExecutor; "
+                    "checkpoint sharded state via distributed.checkpoint")
             fetches = {n: env[n] for n in fetch_names}
             # no `if in env` guard: out_shardings is built per written_state,
             # so the output pytree structure must match it exactly

@@ -169,3 +169,61 @@ def test_v2_ploter_collects_and_renders(tmp_path):
         assert os.path.getsize(out) > 0
     p.reset()
     assert p.__plot_data__["train"].step == []
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    """save/load as graph ops (reference save_op.cc/load_op.cc): persistence
+    happens inside the compiled step, ordered with the computation."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    path = str(tmp_path / "ckpt" / "w.npy")
+    x = fluid.layers.data("slx", shape=[3], dtype="float32")
+    doubled = fluid.layers.scale(x, scale=2.0)
+    block = fluid.default_main_program().global_block()
+    block.append_op("save", inputs={"X": [doubled.name]}, outputs={},
+                    attrs={"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace())
+    val = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+    exe.run(feed={"slx": val}, fetch_list=[doubled])
+    np.testing.assert_allclose(np.load(path), 2 * val)
+
+    # second program loads it back as a graph op
+    fluid.reset()
+    block = fluid.default_main_program().global_block()
+    out = block.create_var(name="loaded", shape=[2, 3], dtype="float32")
+    block.append_op("load", inputs={}, outputs={"Out": [out.name]},
+                    attrs={"file_path": path})
+    bumped = fluid.layers.scale(out, bias=1.0)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe2.run(feed={}, fetch_list=[bumped])
+    np.testing.assert_allclose(got, 2 * val + 1)
+
+
+
+def test_save_op_extensionless_path_roundtrip(tmp_path):
+    """Reference save_op paths carry no extension; the write must not grow
+    a .npy suffix (np.save(path) would)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    path = str(tmp_path / "w0")
+    x = fluid.layers.data("sex", shape=[2], dtype="float32")
+    block = fluid.default_main_program().global_block()
+    block.append_op("save", inputs={"X": [x.name]}, outputs={},
+                    attrs={"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace())
+    v = np.ones((1, 2), np.float32)
+    exe.run(feed={"sex": v}, fetch_list=[x])
+    import os
+
+    assert os.path.exists(path) and not os.path.exists(path + ".npy")
+    fluid.reset()
+    block = fluid.default_main_program().global_block()
+    out = block.create_var(name="l2", shape=[1, 2], dtype="float32")
+    block.append_op("load", inputs={}, outputs={"Out": [out.name]},
+                    attrs={"file_path": path})
+    (got,) = fluid.Executor(fluid.CPUPlace()).run(feed={}, fetch_list=[out])
+    np.testing.assert_allclose(got, v)
