@@ -27,13 +27,23 @@ from .transpiler import DistributeTranspiler, ShardingRules
 
 class ParallelExecutor(Executor):
     def __init__(self, mesh=None, axes: Optional[Dict[str, int]] = None,
-                 rules: Optional[ShardingRules] = None, devices=None):
+                 rules: Optional[ShardingRules] = None, devices=None,
+                 zero_dp_states: bool = False):
         super().__init__(place=None)
         self._pin_device = False
         self.mesh = mesh if mesh is not None else make_mesh(axes, devices)
         self.transpiler = DistributeTranspiler(rules)
         self._plans: Dict[int, Dict[str, object]] = {}
-        self._sharded_scopes = set()
+        # ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336):
+        # optimizer accumulators are sharded over 'dp' so each replica stores
+        # and updates 1/dp of the optimizer state; GSPMD turns the gradient
+        # all-reduce into reduce-scatter + post-update param all-gather
+        self.zero_dp_states = bool(zero_dp_states)
+        self._active_scope = None
+        # positive identification: ZeRO reshards ONLY names derived from a
+        # trainable parameter ("<param>_<accumulator>"), never model state
+        # like batch-norm running stats or metric counters
+        self._zero_param_names = set()
 
     # ------------------------------------------------------------------
     def _plan_for(self, program):
@@ -42,6 +52,12 @@ class ParallelExecutor(Executor):
         if plan is None:
             plan = self.transpiler.transpile(program, self.mesh)
             self._plans[key] = plan
+            if self.zero_dp_states:
+                from ..framework.core import Parameter
+
+                self._zero_param_names |= {
+                    v.name for v in program.global_block().vars.values()
+                    if isinstance(v, Parameter)}
         return plan
 
     def _replicated(self):
@@ -52,14 +68,45 @@ class ParallelExecutor(Executor):
     def _shard_of(self, plan, name):
         s = plan.get(name)
         if s is not None:
-            return s
+            return self._maybe_zero_shard(name, s)
         # optimizer accumulators follow their parameter (name prefix match)
         best = None
         for pname, sh in plan.items():
             if name.startswith(pname) and (best is None or
                                            len(pname) > len(best[0])):
                 best = (pname, sh)
-        return best[1] if best else self._replicated()
+        if best is None:
+            return self._replicated()
+        return self._maybe_zero_shard(name, best[1])
+
+    def _maybe_zero_shard(self, name, sharding):
+        """ZeRO-1: shard an optimizer accumulator (a name derived from a
+        trainable parameter) over the replica axis on dim 0 when divisible."""
+        if not self.zero_dp_states:
+            return sharding
+        if not any(name != p and name.startswith(p + "_")
+                   for p in self._zero_param_names):
+            return sharding
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rules = self.transpiler.rules
+        dp_axis = rules.dp_axis
+        dp = rules._axis_size(self.mesh, dp_axis)
+        shape = self._state_shape(name)
+        spec = tuple(sharding.spec)
+        if (dp > 1 and shape and len(shape) >= 1
+                and shape[0] % dp == 0 and shape[0] >= dp
+                and (not spec or spec[0] is None)):
+            new_spec = (dp_axis,) + tuple(spec[1:] if spec else ())
+            return NamedSharding(self.mesh, PartitionSpec(*new_spec))
+        return sharding
+
+    def _state_shape(self, name):
+        scope = self._active_scope
+        if scope is None:
+            return None
+        v = scope.find(name)
+        return tuple(v.shape) if v is not None else None
 
     # ------------------------------------------------------------------
     def _prepare_feeds(self, block, feed):
@@ -84,7 +131,12 @@ class ParallelExecutor(Executor):
         return out
 
     def _distribute_state(self, program, scope, names):
-        """device_put persistables to their planned shardings (once)."""
+        """device_put persistables to their planned shardings.
+
+        Keyed on the value's ACTUAL sharding, not a seen-before tag: a
+        re-run startup program may write state back with a different layout
+        (e.g. replicated accumulators under ZeRO), and the cached training
+        executable's in_shardings demand the planned one."""
         import jax
 
         plan = self._plan_for(program)
@@ -92,11 +144,11 @@ class ParallelExecutor(Executor):
             v = scope.find(n)
             if v is None:
                 continue
-            tag = (id(scope), n)
-            if tag in self._sharded_scopes:
+            target = self._shard_of(plan, n)
+            current = getattr(v, "sharding", None)
+            if current is not None and current == target:
                 continue
-            scope.set(n, jax.device_put(v, self._shard_of(plan, n)))
-            self._sharded_scopes.add(tag)
+            scope.set(n, jax.device_put(v, target))
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, block_id=0):
@@ -104,6 +156,7 @@ class ParallelExecutor(Executor):
 
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
+        self._active_scope = scope  # accumulator shapes for zero sharding
         block = program.blocks[block_id]
         # pre-shard all scope state the block touches
         names = set()
