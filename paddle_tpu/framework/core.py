@@ -16,6 +16,7 @@ save/load/prune/transpile contract of framework.proto without carrying proto2.
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 from typing import Any, Dict, List, Optional
 
@@ -106,6 +107,10 @@ class Variable:
         self.stop_gradient = stop_gradient
         self.lod_level = lod_level
         self.is_data = is_data
+        # set by Optimizer._add_accumulator: name of the parameter this var
+        # is an optimizer accumulator for (positive id for ZeRO sharding —
+        # never inferred from name prefixes)
+        self.accumulator_for: Optional[str] = None
 
     # -- python operator sugar (fluid exposes the same on Variable) ---------
     def _binary(self, other, op_type, reverse=False):
@@ -151,6 +156,8 @@ class Variable:
             "lod_level": self.lod_level,
             "is_data": self.is_data,
         }
+        if getattr(self, "accumulator_for", None):
+            d["accumulator_for"] = self.accumulator_for
         if isinstance(self, Parameter):
             d["is_parameter"] = True
             d["trainable"] = self.trainable
@@ -168,7 +175,7 @@ class Variable:
                 stop_gradient=d["stop_gradient"],
                 lod_level=d.get("lod_level", 0),
             )
-        return Variable(
+        v = Variable(
             block,
             d["name"],
             shape=d["shape"],
@@ -179,6 +186,8 @@ class Variable:
             lod_level=d.get("lod_level", 0),
             is_data=d.get("is_data", False),
         )
+        v.accumulator_for = d.get("accumulator_for")
+        return v
 
 
 class Parameter(Variable):
@@ -346,10 +355,16 @@ class Block:
 class Program:
     """A whole model: list of blocks, block 0 is global (fluid framework.py:788)."""
 
+    # process-wide monotonic id source: unlike id(), tokens are never reused
+    # after garbage collection, so executor cache keys can't alias between a
+    # dead Program and a new one at the same address
+    _token_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self._version = 0  # bumped on mutation; executor cache key component
+        self._cache_token = next(Program._token_counter)
         self._next_uid = 0
         self.random_seed = 0
 

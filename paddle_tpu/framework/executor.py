@@ -35,6 +35,11 @@ logger = logging.getLogger("paddle_tpu")
 _NOOP_TYPES = ("feed", "fetch")
 
 
+class OpLoweringError(RuntimeError):
+    """An op failed to lower, annotated with op type + variable names
+    (EnforceNotMet parity — reference enforce.h:64)."""
+
+
 _SAVE_PREFIX = "__save__"
 
 
@@ -66,6 +71,7 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place if place is not None else default_place()
         self._cache: Dict[tuple, _Compiled] = {}
+        self._load_paths: Dict[tuple, tuple] = {}
         self._step = 0
         # subclasses running sharded over a mesh bypass single-device pinning
         self._pin_device = True
@@ -93,10 +99,16 @@ class Executor:
         feed_vals = self._prepare_feeds(block, feed)
 
         key = self._cache_key(program, block_id, feed_vals, fetch_names)
-        compiled = self._cache.get(key)
-        if compiled is None:
+        # the load-file signature lives beside the entry, not in the key: a
+        # rewritten load file must *replace* the stale executable, not leak
+        # an unbounded trail of dead cache entries
+        load_sig = self._load_file_sig(program)
+        entry = self._cache.get(key)
+        if entry is None or entry[0] != load_sig:
             compiled = self._compile(program, block_id, feed_vals, fetch_names)
-            self._cache[key] = compiled
+            self._cache[key] = (load_sig, compiled)
+        else:
+            compiled = entry[1]
 
         import jax
 
@@ -194,8 +206,44 @@ class Executor:
         feed_sig = tuple(
             (n, v.shape, str(v.dtype)) for n, v in sorted(feed_vals.items())
         )
-        return (id(program), program._version, block_id, feed_sig,
+        # program._cache_token is a never-reused monotonic id; id(program)
+        # could alias a garbage-collected Program and serve a stale executable
+        return (program._cache_token, program._version, block_id, feed_sig,
                 tuple(fetch_names), self.place)
+
+    def _load_file_sig(self, program):
+        """`load` ops read their file at trace time (reference load_op.cc
+        reads per execution); comparing (mtime, size) per load file makes a
+        changed file retrace instead of serving the stale embedded constant.
+        The path list is computed once per program version (all blocks, so
+        loads inside while/cond sub-blocks count too); the common no-load
+        case costs one dict hit per run."""
+        import os
+
+        pkey = (program._cache_token, program._version)
+        paths = self._load_paths.get(pkey)
+        if paths is None:
+            # a version bump obsoletes older entries for the same program
+            for old in [k for k in self._load_paths
+                        if k[0] == program._cache_token]:
+                del self._load_paths[old]
+            paths = tuple(
+                str(op.attrs.get("file_path", ""))
+                for b in program.blocks for op in b.ops if op.type == "load")
+            self._load_paths[pkey] = paths
+        if not paths:
+            return ()
+        sig = []
+        for path in paths:
+            try:
+                st = os.stat(path)
+                # size too: coarse-mtime filesystems can miss a rewrite
+                # landing in the same tick
+                stamp = (st.st_mtime, st.st_size)
+            except OSError:
+                stamp = (-1.0, -1)
+            sig.append((path, stamp))
+        return tuple(sig)
 
     # ------------------------------------------------------------------
     def _analyze(self, block, feed_names):
@@ -259,7 +307,8 @@ class Executor:
             env.update(state_r)
             env.update(state_w)
             env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
-            ctx = EmitContext(rng_key, is_test=is_test, program=program)
+            ctx = EmitContext(rng_key, is_test=is_test, program=program,
+                              place=self.place if self._pin_device else None)
 
             def lower_sub(idx, sub_env):
                 ctx.sub_depth += 1
@@ -298,21 +347,34 @@ def _lower_ops(ops, env, ctx):
     for op in ops:
         if op.type in _NOOP_TYPES:
             continue
-        info = get_op_info(op.type)
-        ins = {
-            slot: [env[n] if n else None for n in names]
-            for slot, names in op.inputs.items()
-        }
-        attrs = op.attrs
-        if op.type == "generic_grad":
-            attrs = dict(op.attrs)
-            attrs["__wanted__"] = {
-                (slot[: -len("@GRAD")], i)
-                for slot, names in op.outputs.items()
-                for i, n in enumerate(names)
-                if n
+        try:
+            info = get_op_info(op.type)
+            ins = {
+                slot: [env[n] if n else None for n in names]
+                for slot, names in op.inputs.items()
             }
-        outs = info.emit(ctx, ins, attrs)
+            attrs = op.attrs
+            if op.type == "generic_grad":
+                attrs = dict(op.attrs)
+                attrs["__wanted__"] = {
+                    (slot[: -len("@GRAD")], i)
+                    for slot, names in op.outputs.items()
+                    for i, n in enumerate(names)
+                    if n
+                }
+            outs = info.emit(ctx, ins, attrs)
+        except OpLoweringError:
+            raise
+        except Exception as e:
+            # PADDLE_ENFORCE parity (enforce.h:64): a failing op names itself
+            # and its variables instead of surfacing a bare JAX traceback
+            in_names = {s: list(ns) for s, ns in op.inputs.items() if ns}
+            out_names = {s: list(ns) for s, ns in op.outputs.items() if ns}
+            raise OpLoweringError(
+                f"error lowering op {op.type!r} "
+                f"(inputs={in_names}, outputs={out_names}): "
+                f"{type(e).__name__}: {e}"
+            ) from e
         for slot, names in op.outputs.items():
             vals = outs.get(slot, []) if outs else []
             for i, n in enumerate(names):

@@ -88,6 +88,7 @@ class LayerHelper:
                 name=var.name, shape=var.shape, dtype=var.dtype,
                 persistable=True,
             )
+            svar.accumulator_for = getattr(var, "accumulator_for", None)
             initializer(svar, sblock)
 
     # ------------------------------------------------------------------

@@ -164,6 +164,8 @@ def program_to_proto(program):
             if d.get("is_parameter"):
                 vdef.is_parameter = True
                 vdef.trainable = bool(d.get("trainable", True))
+            if d.get("accumulator_for"):
+                vdef.accumulator_for = d["accumulator_for"]
             spec = getattr(var, "partition_spec", None)
             if spec is not None:
                 vdef.partition_spec = json.dumps(spec)
@@ -211,6 +213,8 @@ def proto_to_program(pdef):
             if vdef.is_parameter:
                 d["is_parameter"] = True
                 d["trainable"] = vdef.trainable
+            if vdef.HasField("accumulator_for"):
+                d["accumulator_for"] = vdef.accumulator_for
             var = Variable.from_dict(block, d)
             if vdef.HasField("partition_spec"):
                 var.partition_spec = json.loads(vdef.partition_spec)

@@ -344,7 +344,12 @@ def unpool(ctx, ins, attrs):
     vals = x.reshape(N * C, h * w)
     flat_idx = idx.reshape(N * C, h * w).astype(jnp.int32)
     out = jnp.zeros((N * C, OH * OW), x.dtype)
-    out = out.at[jnp.arange(N * C)[:, None], flat_idx].set(vals)
+    # Mask is -1 for a window lying entirely in padding; a raw scatter would
+    # wrap -1 to the last flat cell.  Negative indices wrap even under
+    # mode='drop', so remap them past the end first, then drop.
+    flat_idx = jnp.where(flat_idx < 0, OH * OW, flat_idx)
+    out = out.at[jnp.arange(N * C)[:, None], flat_idx].set(
+        vals, mode="drop")
     return {"Out": [out.reshape(N, C, OH, OW)]}
 
 

@@ -85,10 +85,16 @@ class EmitContext:
     """Per-lowering state handed to emitters: RNG derivation, train/test mode,
     and program access for ops with sub-blocks (while/cond — AttrType.BLOCK)."""
 
-    def __init__(self, key, is_test: bool, program=None, lower_block=None):
+    def __init__(self, key, is_test: bool, program=None, lower_block=None,
+                 place=None):
         self.key = key
         self.is_test = is_test
         self.program = program
+        # the Place this trace targets (None under ParallelExecutor, which
+        # sets `mesh` instead); emitters gate backend-specific kernels
+        # (Pallas) on target_platform(), not the process-global backend
+        self.place = place
+        self.mesh = None
         # callable(block_idx, env) -> env  provided by the executor so control
         # flow ops can lower nested blocks
         self.lower_block = lower_block
@@ -109,6 +115,18 @@ class EmitContext:
 
         uid = int(attrs.get("__uid__", 0))
         return jax.random.fold_in(self.key, uid)
+
+    def target_platform(self) -> str:
+        """Platform ('tpu'/'cpu'/...) of the device(s) this trace will run
+        on — the executor's pinned place or the mesh, falling back to the
+        process default backend."""
+        import jax
+
+        if self.mesh is not None:
+            return self.mesh.devices.flat[0].platform
+        if self.place is not None:
+            return self.place.jax_device().platform
+        return jax.default_backend()
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,9 @@ class Optimizer:
             name=unique_name.generate(f"{param.name}_{name}"),
             shape=shape or param.shape,
             dtype=dtype or "float32")
+        # positive identification for sharding (ParallelExecutor ZeRO): a
+        # name-prefix rule would misclassify user params like 'w' vs 'w_1'
+        acc.accumulator_for = param.name
         self.helper.set_initialized(acc, ConstantInitializer(fill_value))
         self._accumulators.setdefault(name, {})[param.name] = acc
         return acc

@@ -40,24 +40,33 @@ class ParallelExecutor(Executor):
         # all-reduce into reduce-scatter + post-update param all-gather
         self.zero_dp_states = bool(zero_dp_states)
         self._active_scope = None
-        # positive identification: ZeRO reshards ONLY names derived from a
-        # trainable parameter ("<param>_<accumulator>"), never model state
-        # like batch-norm running stats or metric counters
-        self._zero_param_names = set()
+        # positive identification: ZeRO reshards ONLY variables tagged
+        # `accumulator_for` by Optimizer._add_accumulator — never model state
+        # like batch-norm running stats, nor a user param whose name happens
+        # to extend another param's name with '_'
+        self._accum_owner: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def _plan_for(self, program):
-        key = (id(program), program._version)
+        key = (program._cache_token, program._version)
         plan = self._plans.get(key)
         if plan is None:
             plan = self.transpiler.transpile(program, self.mesh)
             self._plans[key] = plan
-            if self.zero_dp_states:
-                from ..framework.core import Parameter
+            self._accum_owner.update({
+                v.name: v.accumulator_for
+                for v in program.global_block().vars.values()
+                if getattr(v, "accumulator_for", None)})
+            if (self.zero_dp_states and not self._accum_owner
+                    and any(op.type.endswith("_grad") or
+                            op.type == "generic_grad"
+                            for op in program.global_block().ops)):
+                import logging
 
-                self._zero_param_names |= {
-                    v.name for v in program.global_block().vars.values()
-                    if isinstance(v, Parameter)}
+                logging.getLogger("paddle_tpu").warning(
+                    "zero_dp_states=True but no variable carries an "
+                    "accumulator_for tag (program saved by an older build?) "
+                    "— optimizer state will stay replicated")
         return plan
 
     def _replicated(self):
@@ -69,23 +78,19 @@ class ParallelExecutor(Executor):
         s = plan.get(name)
         if s is not None:
             return self._maybe_zero_shard(name, s)
-        # optimizer accumulators follow their parameter (name prefix match)
-        best = None
-        for pname, sh in plan.items():
-            if name.startswith(pname) and (best is None or
-                                           len(pname) > len(best[0])):
-                best = (pname, sh)
-        if best is None:
-            return self._replicated()
-        return self._maybe_zero_shard(name, best[1])
+        # optimizer accumulators follow their parameter (positive tag from
+        # Optimizer._add_accumulator, carried on the VarDesc)
+        owner = self._accum_owner.get(name)
+        if owner is not None and owner in plan:
+            return self._maybe_zero_shard(name, plan[owner])
+        return self._replicated()
 
     def _maybe_zero_shard(self, name, sharding):
-        """ZeRO-1: shard an optimizer accumulator (a name derived from a
-        trainable parameter) over the replica axis on dim 0 when divisible."""
+        """ZeRO-1: shard an optimizer accumulator (a var positively tagged by
+        the optimizer) over the replica axis on dim 0 when divisible."""
         if not self.zero_dp_states:
             return sharding
-        if not any(name != p and name.startswith(p + "_")
-                   for p in self._zero_param_names):
+        if name not in self._accum_owner:
             return sharding
         from jax.sharding import NamedSharding, PartitionSpec
 

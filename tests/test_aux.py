@@ -227,3 +227,42 @@ def test_save_op_extensionless_path_roundtrip(tmp_path):
                     attrs={"file_path": path})
     (got,) = fluid.Executor(fluid.CPUPlace()).run(feed={}, fetch_list=[out])
     np.testing.assert_allclose(got, v)
+
+
+def test_op_lowering_error_names_op():
+    """A failing op must name its type and variables in the raised error
+    (PADDLE_ENFORCE parity — reference enforce.h:64)."""
+    from paddle_tpu.framework.executor import OpLoweringError
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    block = fluid.default_main_program().global_block()
+    out = block.create_var(name="bad_out", shape=[4], dtype="float32")
+    # concat with mismatched ranks fails inside the emitter at trace time
+    y = fluid.layers.data(name="y", shape=[2, 3], dtype="float32")
+    block.append_op("concat", inputs={"X": [x.name, y.name]},
+                    outputs={"Out": [out.name]}, attrs={"axis": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(OpLoweringError) as ei:
+        exe.run(feed={"x": np.ones((2, 4), np.float32),
+                      "y": np.ones((2, 2, 3), np.float32)},
+                fetch_list=[out])
+    msg = str(ei.value)
+    assert "'concat'" in msg and "bad_out" in msg
+
+
+def test_executor_cache_token_never_aliases():
+    """Cache keys use a monotonic per-Program token, not id(): two different
+    Programs never share a key even if id() is reused after gc."""
+    import gc
+
+    from paddle_tpu.framework.core import Program
+
+    p1 = Program()
+    tok1 = p1._cache_token
+    del p1
+    gc.collect()
+    p2 = Program()
+    assert p2._cache_token != tok1
+    exe = fluid.Executor(fluid.CPUPlace())
+    k = exe._cache_key(p2, 0, {}, [])
+    assert k[0] == p2._cache_token

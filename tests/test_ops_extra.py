@@ -58,6 +58,19 @@ def test_unpool_roundtrip():
     t.check_grad(["X"])
 
 
+def test_unpool_drops_padding_mask():
+    # a Mask of -1 (window entirely in padding) must be dropped, not wrap to
+    # the last flat position
+    pooled = np.full((1, 1, 1, 2), 5.0)
+    idx = np.array([[[[-1, 2]]]], np.int32)
+    t = OpTestHarness("unpool", {"X": pooled, "Indices": idx},
+                      {"ksize": [2, 2], "strides": [2, 2],
+                       "output_size": [2, 2]})
+    want = np.zeros((1, 1, 2, 2))
+    want[0, 0, 1, 0] = 5.0  # flat index 2; nothing at flat index 3
+    t.check_output({"Out": want})
+
+
 def test_spp_shapes_and_level0():
     x = _r(2, 3, 6, 6)
     t = OpTestHarness("spp", {"X": x}, {"pyramid_height": 2,

@@ -214,3 +214,33 @@ def test_feed_only_backward_for_host_embedding():
     g = exe.run(feed={"emb": np.ones((2, 8), np.float32)},
                 fetch_list=["emb@GRAD"])[0]
     assert np.asarray(g).shape == (2, 8)
+
+
+def test_accumulator_tag_survives_proto_roundtrip():
+    """accumulator_for (set by Optimizer._add_accumulator) must round-trip
+    through the wire format so ZeRO/placement works on restored programs."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.framework.core import Program
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+    prog = fluid.default_main_program()
+    tags = {v.name: v.accumulator_for
+            for v in prog.global_block().vars.values()
+            if getattr(v, "accumulator_for", None)}
+    assert tags, "Adam should have created tagged accumulators"
+    restored = Program.from_proto(prog.to_proto())
+    rtags = {v.name: v.accumulator_for
+             for v in restored.global_block().vars.values()
+             if getattr(v, "accumulator_for", None)}
+    assert rtags == tags
+    # and through JSON too
+    jtags = {v.name: v.accumulator_for
+             for v in Program.from_json(prog.to_json())
+             .global_block().vars.values()
+             if getattr(v, "accumulator_for", None)}
+    assert jtags == tags
