@@ -193,7 +193,15 @@ class StaticRNN:
             [i.name for _, i in self._step_inputs]
             + [m[0].name for m in self._memories])
         ext = _externals(self.program, self._sub, exclude=inner_names)
-        outs = [helper.create_tmp_variable(o.dtype) for o in self._outputs]
+        # outer output shape [B, T, ...inner feature dims] — T is dynamic,
+        # but the feature tail is what downstream fc/pool layers need
+        outs = [
+            helper.create_tmp_variable(
+                o.dtype,
+                shape=((o.shape[0], -1) + tuple(o.shape[1:]))
+                if o.shape else None)
+            for o in self._outputs
+        ]
         mem_finals = [
             helper.create_tmp_variable(m[2].dtype, shape=m[2].shape)
             for m in self._memories

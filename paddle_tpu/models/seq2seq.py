@@ -168,3 +168,109 @@ class Seq2SeqAttention:
                    "bos_id": self.bos_id, "eos_id": self.eos_id},
         )
         return ids, scores, lengths
+
+    # ------------------------------------------------------------------
+    def generate_composable(self, src_words, beam_size=4, max_len=16):
+        """Generation built from the COMPOSABLE ops (reference
+        beam_search_op.h:96 + beam_search_decode_op.cc:41 composed in a
+        while loop, as fluid's test_machine_translation does): the decoder
+        step (attention_gru_cell) is an ordinary op in the loop body, so any
+        user decoder slots in its place; beam bookkeeping is the generic
+        beam_search / beam_gather / beam_search_decode ops.
+
+        Returns (ids [B,K,L], scores [B,K], lengths [B,K])."""
+        hp = self._helper
+        K, L = int(beam_size), int(max_len)
+        enc = self.encode(src_words)
+        enc_len = layers.get_length_var(enc)
+        h0 = self._decoder_h0(enc)  # [B,H]
+
+        def batch_like(shape, value, dtype, out_idx):
+            out = hp.create_tmp_variable(dtype, shape=tuple(shape),
+                                         stop_gradient=True)
+            hp.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [h0.name]}, outputs={"Out": [out.name]},
+                attrs={"shape": list(shape), "value": value, "dtype": dtype,
+                       "input_dim_idx": 0, "output_dim_idx": out_idx})
+            return out
+
+        # beam state: h [B,K,H] broadcast from h0; tokens start at <bos>;
+        # lane 0 live, others dead (identical lanes would waste the beam)
+        h3 = hp.create_tmp_variable(self.dtype, shape=(-1, K, self.hidden),
+                                    stop_gradient=True)
+        hp.append_op("unsqueeze", inputs={"X": [h0.name]},
+                     outputs={"Out": [h3.name]}, attrs={"axes": [1]})
+        zeros_k = batch_like([-1, K], 0.0, "float32", 0)
+        h = layers.elementwise_add(h3, layers.reshape(
+            layers.fill_constant([K, 1], self.dtype, 0.0), [1, K, 1]))
+        tokens = batch_like([-1, K], float(self.bos_id), "int64", 0)
+        lane_dead = hp.create_tmp_variable("float32", shape=(1, K),
+                                           stop_gradient=True)
+        hp.append_op("assign_value", inputs={},
+                     outputs={"Out": [lane_dead.name]},
+                     attrs={"shape": [1, K],
+                            "fp32_values": [0.0] + [-1e9] * (K - 1)})
+        scores = layers.elementwise_add(zeros_k, lane_dead)
+
+        ids_arr = batch_like([L, -1, K], 0.0, "int64", 1)
+        par_arr = batch_like([L, -1, K], 0.0, "int32", 1)
+
+        t = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        n = layers.fill_constant(shape=[1], dtype="float32", value=float(L))
+        ti = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        cond = layers.less_than(t, n)
+        w = layers.While(cond)
+        with w.block():
+            h_new = hp.create_tmp_variable(self.dtype, shape=None,
+                                           stop_gradient=True)
+            logp = hp.create_tmp_variable(self.dtype, shape=None,
+                                          stop_gradient=True)
+            hp.append_op(
+                "attention_gru_cell",
+                inputs={"EncOut": [enc.name], "EncLength": [enc_len.name],
+                        "H": [h.name], "Tokens": [tokens.name],
+                        "Embedding": [self.tgt_emb.name],
+                        "WIn": [self.w_in.name], "BIn": [self.b_in.name],
+                        "WH": [self.w_h.name], "WQuery": [self.w_q.name],
+                        "WMem": [self.w_m.name], "V": [self.v.name],
+                        "WOut": [self.w_out.name],
+                        "BOut": [self.b_out.name]},
+                outputs={"HNew": [h_new.name], "Logp": [logp.name]})
+            # candidate pruning exactly as the fluid loop: top-K of the
+            # step distribution, then the generic beam_search op
+            cand_scores, cand_ids = layers.topk(logp, K)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                tokens, scores, cand_ids, cand_scores,
+                beam_size=K, end_id=self.eos_id, is_accumulated=False)
+            # reorder decoder state by surviving parents
+            h_sel = hp.create_tmp_variable(self.dtype, shape=None,
+                                           stop_gradient=True)
+            hp.append_op("beam_gather",
+                         inputs={"X": [h_new.name], "Index": [parent.name]},
+                         outputs={"Out": [h_sel.name]})
+            # record the step
+            ids_w = hp.create_tmp_variable("int64", shape=None,
+                                           stop_gradient=True)
+            hp.append_op("array_write",
+                         inputs={"Array": [ids_arr.name],
+                                 "X": [sel_ids.name], "I": [ti.name]},
+                         outputs={"Out": [ids_w.name]})
+            par_w = hp.create_tmp_variable("int32", shape=None,
+                                           stop_gradient=True)
+            hp.append_op("array_write",
+                         inputs={"Array": [par_arr.name],
+                                 "X": [parent.name], "I": [ti.name]},
+                         outputs={"Out": [par_w.name]})
+            layers.assign(ids_w, ids_arr)
+            layers.assign(par_w, par_arr)
+            layers.assign(h_sel, h)
+            layers.assign(sel_ids, tokens)
+            layers.assign(sel_scores, scores)
+            layers.increment(t, 1.0)
+            layers.increment(ti, 1)
+            layers.less_than(t, n, cond=cond)
+
+        sent, sscores, slen = layers.beam_search_decode(
+            ids_arr, par_arr, scores, end_id=self.eos_id)
+        return sent, sscores, slen

@@ -11,6 +11,7 @@ from . import nn_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import beam_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import detection_ops  # noqa: F401

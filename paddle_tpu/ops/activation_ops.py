@@ -102,7 +102,17 @@ def maxout(ctx, ins, attrs):
 
 @register_op("prelu")
 def prelu(ctx, ins, attrs):
+    """Parametric ReLU (reference prelu_op.cc + gserver ParameterReluLayer's
+    partial_sum sharing): Alpha of size 1 = all-shared, size C = channel
+    -shared over [N,C,...], anything else broadcast over the batch dim."""
     import jax.numpy as jnp
 
     x, alpha = ins["X"][0], ins["Alpha"][0]
-    return {"Out": [jnp.where(x > 0, x, alpha.reshape(-1)[0] * x)]}
+    n = int(alpha.size)
+    if n == 1:
+        a = alpha.reshape(-1)[0]
+    elif x.ndim >= 2 and n == int(x.shape[1]):
+        a = alpha.reshape((1, n) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + tuple(x.shape[1:]))
+    return {"Out": [jnp.where(x > 0, x, a * x)]}

@@ -112,6 +112,39 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_op("attention_gru_cell", grad=None, non_diff_inputs=("EncLength",
+                                                               "Tokens"))
+def attention_gru_cell(ctx, ins, attrs):
+    """ONE decoder step over beam lanes — the user-decoder piece of the
+    composable generation loop (the fused scan above does the whole loop;
+    this op lets the beam_search op pair with any per-step decoder inside a
+    While block).  Inputs: EncOut [B,Ts,E], EncLength [B], H [B,K,H],
+    Tokens [B,K] int, Embedding [V,D], WIn/BIn/WH/WQuery/WMem/V.
+    Outputs: HNew [B,K,H], Logp [B,K,Vo] (log-softmax over WOut/BOut)."""
+    import jax
+    import jax.numpy as jnp
+
+    enc_out = ins["EncOut"][0]
+    enc_len = ins["EncLength"][0]
+    h = ins["H"][0]
+    tokens = ins["Tokens"][0].astype(jnp.int32)
+    emb = ins["Embedding"][0]
+    w_in, b_in = ins["WIn"][0], ins["BIn"][0]
+    w_h = ins["WH"][0]
+    w_q, w_m, v = ins["WQuery"][0], ins["WMem"][0], ins["V"][0]
+    w_out, b_out = ins["WOut"][0], ins["BOut"][0]
+
+    Ts = enc_out.shape[1]
+    enc_mask = _mask(enc_len, Ts)
+    enc_proj = enc_out @ w_m
+    x = emb[tokens]  # [B,K,D]
+    ctx_vec, _ = _attend(h, enc_proj, enc_out, enc_mask, w_q, v)
+    xc = jnp.concatenate([x, ctx_vec], axis=-1)
+    h_new = _gru_cell(xc, h, w_in, b_in, w_h)
+    logits = h_new @ w_out + b_out
+    return {"HNew": [h_new], "Logp": [jax.nn.log_softmax(logits, axis=-1)]}
+
+
 @register_op("beam_search_generate", grad=None)
 def beam_search_generate(ctx, ins, attrs):
     """Beam-search decoding, fully on device.

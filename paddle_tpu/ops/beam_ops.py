@@ -1,0 +1,126 @@
+"""Composable beam-search ops.
+
+Reference: the per-step `beam_search` op (operators/beam_search_op.h:96
+`class BeamSearch` — select beam_size continuations of each partial
+hypothesis, pruning after end_id) and `beam_search_decode`
+(operators/beam_search_decode_op.cc:41 `PackAllSteps` — walk every step's
+selections back into full sentences).
+
+TPU-native redesign: the reference prunes hypotheses with dynamic LoD
+offsets per step; under XLA the beam is a STATIC [B, K] lane set — dead or
+finished beams stay in their lanes at -inf / frozen score, so every step is
+the same fixed-shape top-k (MXU/VPU friendly) and the whole generation loop
+compiles into one while/scan program.  Step selections are written into
+dense [L, B, K] arrays (array_write), and beam_search_decode backtracks the
+parent pointers with a reverse scan instead of packing LoD."""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+DEAD = -1e9  # score of an unused beam lane
+
+
+@register_op("beam_search", grad=None,
+             non_diff_inputs=("PreIds", "Ids"))
+def beam_search(ctx, ins, attrs):
+    """One beam step.
+
+    Inputs:
+      PreIds    [B, K] int   — last token of each live hypothesis
+      PreScores [B, K] f32   — cumulative log-prob per hypothesis
+      Ids       [B, K, C] int — candidate token ids per beam (e.g. top-k of
+                the decoder distribution); C = candidate count
+      Scores    [B, K, C] f32 — candidate scores; with is_accumulated=False
+                they are per-step log-probs and are added to PreScores,
+                otherwise they are already cumulative
+    Attrs: beam_size, end_id, is_accumulated (default True)
+    Outputs:
+      SelectedIds    [B, K] int  — chosen next token per surviving beam
+      SelectedScores [B, K] f32  — updated cumulative log-probs
+      ParentIdx      [B, K] int32 — which input beam each survivor extends
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pre_ids = ins["PreIds"][0]
+    pre_scores = ins["PreScores"][0].astype(jnp.float32)
+    cand_ids = ins["Ids"][0]
+    cand_scores = ins["Scores"][0].astype(jnp.float32)
+    K = int(attrs.get("beam_size", pre_ids.shape[1]))
+    end_id = int(attrs.get("end_id", 1))
+    accumulated = bool(attrs.get("is_accumulated", True))
+
+    B, Kin, C = cand_scores.shape
+    if not accumulated:
+        cand_scores = cand_scores + pre_scores[:, :, None]
+
+    finished = pre_ids == end_id
+    # a finished hypothesis proposes exactly one continuation: end_id at its
+    # frozen score (candidate slot 0); its other slots are dead
+    slot = jnp.arange(C)[None, None, :]
+    cand_scores = jnp.where(
+        finished[:, :, None],
+        jnp.where(slot == 0, pre_scores[:, :, None], DEAD),
+        cand_scores)
+    cand_ids = jnp.where(finished[:, :, None], end_id, cand_ids)
+    # dead lanes (score already at DEAD) never revive
+    cand_scores = jnp.where(pre_scores[:, :, None] <= DEAD / 2,
+                            DEAD, cand_scores)
+
+    flat = cand_scores.reshape(B, Kin * C)
+    top_scores, top_idx = jax.lax.top_k(flat, K)
+    parent = (top_idx // C).astype(jnp.int32)
+    sel_ids = jnp.take_along_axis(
+        cand_ids.reshape(B, Kin * C), top_idx, axis=1).astype(pre_ids.dtype)
+    return {"SelectedIds": [sel_ids], "SelectedScores": [top_scores],
+            "ParentIdx": [parent]}
+
+
+@register_op("beam_search_decode", grad=None)
+def beam_search_decode(ctx, ins, attrs):
+    """Pack every step's selections into whole sentences.
+
+    Inputs:
+      Ids       [L, B, K] int   — per-step selected tokens (array_write'd)
+      ParentIdx [L, B, K] int32 — per-step parent pointers
+      Scores    [B, K] f32      — final cumulative scores
+      StepCount [1] int (optional) — number of valid steps (<= L)
+    Attrs: end_id
+    Outputs:
+      SentenceIds    [B, K, L] int — backtracked hypotheses, end_id padded
+      SentenceScores [B, K] f32
+      SentenceLength [B, K] int32 — tokens before (and excluding) end_id
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ids = ins["Ids"][0]
+    parents = ins["ParentIdx"][0]
+    scores = ins["Scores"][0]
+    end_id = int(attrs.get("end_id", 1))
+    L, B, K = ids.shape
+    steps = None
+    if ins.get("StepCount") and ins["StepCount"][0] is not None:
+        steps = ins["StepCount"][0].reshape(()).astype(jnp.int32)
+
+    def back(lane, t):
+        # t runs L-1 .. 0; lane [B,K] = which beam at step t+1 each final
+        # hypothesis occupied
+        tok = jnp.take_along_axis(ids[t], lane, axis=1)
+        par = jnp.take_along_axis(parents[t], lane, axis=1)
+        if steps is not None:
+            # steps beyond the actual loop count contribute padding
+            live = t < steps
+            tok = jnp.where(live, tok, end_id)
+            par = jnp.where(live, par, lane)
+        return par.astype(jnp.int32), tok
+
+    lane0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], (B, K))
+    _, toks = jax.lax.scan(back, lane0, jnp.arange(L - 1, -1, -1))
+    sent = jnp.flip(jnp.moveaxis(toks, 0, -1), axis=-1)  # [B, K, L]
+    not_end = (sent != end_id).astype(jnp.int32)
+    length = jnp.sum(
+        jnp.cumprod(not_end, axis=-1), axis=-1).astype(jnp.int32)
+    return {"SentenceIds": [sent], "SentenceScores": [scores],
+            "SentenceLength": [length]}

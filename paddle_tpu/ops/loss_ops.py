@@ -340,3 +340,84 @@ def hsigmoid(ctx, ins, attrs):
         t = 2.0 * bit.astype(x.dtype) - 1.0
         losses = losses + jnp.where(valid, jax.nn.softplus(-t * z), 0.0)
     return {"Out": [losses[:, None]]}
+
+
+@register_op("huber_classification", non_diff_inputs=("Label",))
+def huber_classification(ctx, ins, attrs):
+    """Huber two-class loss (reference HuberTwoClassification,
+    gserver/layers/CostLayer.cpp): labels in {0,1} mapped to y=±1;
+    loss = 0 if y·f > 1, (1 - y·f)² if -1 ≤ y·f ≤ 1, -4·y·f if y·f < -1."""
+    import jax.numpy as jnp
+
+    f = ins["X"][0].reshape(-1)
+    y = ins["Label"][0].reshape(-1).astype(jnp.float32) * 2.0 - 1.0
+    m = y * f
+    loss = jnp.where(m < -1.0, -4.0 * m,
+                     jnp.where(m < 1.0, (1.0 - m) ** 2, 0.0))
+    return {"Out": [loss.reshape(-1, 1)]}
+
+
+@register_op("cross_entropy_selfnorm", non_diff_inputs=("Label",))
+def cross_entropy_selfnorm(ctx, ins, attrs):
+    """Self-normalizing cross entropy (reference
+    CrossEntropyOverSelfNorm, gserver CostLayer): input rows are positive
+    un-normalized scores; the alpha term pushes each row sum toward 1 so
+    inference can skip normalization."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    alpha = float(attrs.get("softmax_selfnorm_alpha", 0.1))
+    eps = 1e-8
+    z = jnp.sum(x, axis=-1)
+    picked = jnp.take_along_axis(x, label[:, None], axis=-1)[:, 0]
+    ce = -jnp.log(picked / (z + eps) + eps)
+    self_norm = alpha * jnp.log(z + eps) ** 2
+    return {"Out": [(ce + self_norm).reshape(-1, 1)]}
+
+
+@register_op("lambda_rank", non_diff_inputs=("Score", "Length"))
+def lambda_rank(ctx, ins, attrs):
+    """LambdaRank listwise cost (reference LambdaCost,
+    gserver/layers/CostLayer.cpp:LambdaCost): per query (= sequence),
+    pairwise logistic loss between mis-ordered documents weighted by the
+    |ΔNDCG@k| of swapping them.  Padded form: X scores [B,T] or [B,T,1],
+    Score relevance labels same shape, Length valid counts."""
+    import jax
+    import jax.numpy as jnp
+
+    s = ins["X"][0]
+    rel = ins["Score"][0]
+    if s.ndim == 3:
+        s = s[..., 0]
+    if rel.ndim == 3:
+        rel = rel[..., 0]
+    lengths = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    ndcg_num = int(attrs.get("NDCG_num", 5))
+    B, T = s.shape
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    relf = rel.astype(jnp.float32)
+    gain = 2.0 ** relf - 1.0
+    # ideal DCG@k normalizer from the top-k relevances per query
+    topk = jax.lax.top_k(jnp.where(valid, relf, -jnp.inf),
+                         min(ndcg_num, T))[0]
+    disc = 1.0 / jnp.log2(jnp.arange(min(ndcg_num, T)) + 2.0)
+    idcg = jnp.sum(jnp.where(jnp.isfinite(topk),
+                             (2.0 ** topk - 1.0) * disc[None, :], 0.0),
+                   axis=1)
+    idcg = jnp.maximum(idcg, 1e-6)
+    # rank positions by current score (0 = highest)
+    order = jnp.argsort(jnp.argsort(
+        jnp.where(valid, -s.astype(jnp.float32), jnp.inf), axis=1), axis=1)
+    dr = 1.0 / jnp.log2(order.astype(jnp.float32) + 2.0)
+    pair_valid = (valid[:, :, None] & valid[:, None, :]
+                  & (relf[:, :, None] > relf[:, None, :]))
+    delta_ndcg = jnp.abs(
+        (gain[:, :, None] - gain[:, None, :])
+        * (dr[:, :, None] - dr[:, None, :])) / idcg[:, None, None]
+    sdiff = s.astype(jnp.float32)[:, :, None] - \
+        s.astype(jnp.float32)[:, None, :]
+    pair_loss = jnp.logaddexp(0.0, -sdiff)  # log(1 + e^{-(si - sj)})
+    loss = jnp.sum(jnp.where(pair_valid, delta_ndcg * pair_loss, 0.0),
+                   axis=(1, 2))
+    return {"Out": [loss.reshape(-1, 1)]}

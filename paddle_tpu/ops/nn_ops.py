@@ -326,6 +326,96 @@ def max_pool2d_with_index(ctx, ins, attrs):
     return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
 
 
+@register_op("bilinear_interp")
+def bilinear_interp(ctx, ins, attrs):
+    """Bilinear up/down-sampling of NCHW feature maps with align-corners
+    ratios (reference gserver/layers/BilinearInterpLayer.cpp: ratio =
+    (in-1)/(out-1))."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    out_h, out_w = int(attrs["out_h"]), int(attrs["out_w"])
+    N, C, H, W = x.shape
+
+    def axis_coords(out_n, in_n):
+        r = (in_n - 1) / (out_n - 1) if out_n > 1 else 0.0
+        pos = jnp.arange(out_n, dtype=jnp.float32) * r
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_n - 1)
+        frac = pos - lo.astype(jnp.float32)
+        return lo, hi, frac
+
+    h0, h1, fh = axis_coords(out_h, H)
+    w0, w1, fw = axis_coords(out_w, W)
+    f32 = x.astype(jnp.float32)
+    top = f32[:, :, h0, :]
+    bot = f32[:, :, h1, :]
+    row = top * (1 - fh)[None, None, :, None] + bot * fh[None, None, :, None]
+    left = row[:, :, :, w0]
+    right = row[:, :, :, w1]
+    out = left * (1 - fw)[None, None, None, :] + right * fw[None, None, None, :]
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("scale_sub_region", non_diff_inputs=("Indices",))
+def scale_sub_region(ctx, ins, attrs):
+    """Multiply a per-sample CHW sub-box by a constant (reference
+    ScaleSubRegionLayer; indices are 1-based inclusive [cs,ce,hs,he,ws,we]
+    rows of shape [N,6])."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # [N,C,H,W]
+    idx = ins["Indices"][0].astype(jnp.int32)  # [N,6]
+    value = float(attrs.get("value", 1.0))
+    N, C, H, W = x.shape
+
+    def rng_mask(n, lo, hi):  # 1-based inclusive box bounds -> bool [N, n]
+        pos = jnp.arange(n)[None, :]
+        return (pos >= (lo - 1)[:, None]) & (pos <= (hi - 1)[:, None])
+
+    m = (rng_mask(C, idx[:, 0], idx[:, 1])[:, :, None, None]
+         & rng_mask(H, idx[:, 2], idx[:, 3])[:, None, :, None]
+         & rng_mask(W, idx[:, 4], idx[:, 5])[:, None, None, :])
+    return {"Out": [jnp.where(m, x * value, x)]}
+
+
+@register_op("max_pool3d_with_index", non_diff_outputs=("Mask",))
+def max_pool3d_with_index(ctx, ins, attrs):
+    """3-D max pool returning flat d*H*W+h*W+w argmax per window (reference
+    pool_with_index_op.cc:277 max_pool3d_with_index) — shares the
+    float-index-patches trick with the 2-D variant."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]  # NCDHW
+    ksize = _triple(attrs.get("ksize", [2, 2, 2]))
+    strides = _triple(attrs.get("strides", ksize))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    N, C, D, H, W = x.shape
+    neg = jnp.finfo(x.dtype).min
+
+    def patches(a, fill):
+        a = jnp.pad(a, ((0, 0), (0, 0)) + tuple((p, p) for p in pads),
+                    constant_values=fill)
+        p = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ksize, window_strides=strides,
+            padding=[(0, 0)] * 3,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        n, _, od, oh, ow = p.shape
+        return p.reshape(n, a.shape[1], ksize[0] * ksize[1] * ksize[2],
+                         od, oh, ow)
+
+    flat = (jnp.arange(D)[:, None, None] * (H * W)
+            + jnp.arange(H)[None, :, None] * W
+            + jnp.arange(W)[None, None, :]).astype(jnp.float32)
+    xp = patches(x, neg)
+    ip = patches(jnp.broadcast_to(flat, (N, C, D, H, W)), -1.0)
+    arg = jnp.argmax(xp, axis=2)
+    out = jnp.max(xp, axis=2)
+    idx = jnp.take_along_axis(ip, arg[:, :, None], axis=2)[:, :, 0]
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
 @register_op("unpool", non_diff_inputs=("Indices",))
 def unpool(ctx, ins, attrs):
     """Max unpooling (reference unpool_op.cc): scatter each pooled value back

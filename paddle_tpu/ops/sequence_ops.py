@@ -392,6 +392,77 @@ def sequence_reshape(ctx, ins, attrs):
     return {"Out": [out], "LengthOut": [new_len.astype(jnp.int32)]}
 
 
+@register_op("kmax_seq_score", grad=None, non_diff_inputs=("Length",))
+def kmax_seq_score(ctx, ins, attrs):
+    """Indices of the beam_size highest scores within each sequence
+    (reference KmaxSeqScoreLayer, gserver/layers/KmaxSeqScoreLayer.cpp):
+    X [B,T] or [B,T,1] scores + Length → int64 [B, k], positions past the
+    sequence end never selected (score forced to -inf)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    if x.ndim == 3:
+        x = x[..., 0]
+    lengths = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    k = int(attrs.get("beam_size", 1))
+    T = x.shape[1]
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    masked = jnp.where(valid, x.astype(jnp.float32), -jnp.inf)
+    _, idx = jax.lax.top_k(masked, min(k, T))
+    return {"Out": [idx.astype(jnp.int64)]}
+
+
+@register_op("sequence_concat_time", non_diff_inputs=("Length",))
+def sequence_concat_time(ctx, ins, attrs):
+    """Concatenate two sequences along TIME per batch row (reference
+    SequenceConcatLayer / v1 seq_concat_layer — distinct from the fluid
+    sequence_concat op, which concatenates features): row b becomes
+    a[b,:la[b]] ++ b[b,:lb[b]], padded to Ta+Tb."""
+    import jax.numpy as jnp
+
+    a, b = ins["X"][0], ins["X"][1]  # [B,Ta,D], [B,Tb,D]
+    la = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    lb = ins["Length"][1].reshape(-1).astype(jnp.int32)
+    B, Ta = a.shape[0], a.shape[1]
+    Tb = b.shape[1]
+    T = Ta + Tb
+    t = jnp.arange(T)[None, :]
+    in_a = t < la[:, None]
+    ai = jnp.clip(t, 0, Ta - 1)
+    bi = jnp.clip(t - la[:, None], 0, Tb - 1)
+    tail = (1,) * (a.ndim - 2)
+    ga = jnp.take_along_axis(a, ai.reshape(ai.shape + tail), axis=1)
+    gb = jnp.take_along_axis(b, bi.reshape(bi.shape + tail), axis=1)
+    sel = in_a.reshape(in_a.shape + tail)
+    out = jnp.where(sel, ga, gb)
+    new_len = la + lb
+    pad_mask = (t < new_len[:, None]).reshape(in_a.shape + tail)
+    return {"Out": [jnp.where(pad_mask, out, 0)],
+            "LengthOut": [new_len]}
+
+
+@register_op("sub_nested_seq", grad=None,
+             non_diff_inputs=("SelectedIndices", "Length"))
+def sub_nested_seq(ctx, ins, attrs):
+    """Select sub-sequences of a nested sequence by per-sample indices
+    (reference SubNestedSequenceLayer, used in beam training): X
+    [B, S, T, D] (S = sub-sequence slots, padded), SubLength [B, S],
+    SelectedIndices [B, K] → Out [B, K, T, D] + selected lengths."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    sub_len = ins["Length"][0].astype(jnp.int32)  # [B, S]
+    sel = ins["SelectedIndices"][0].astype(jnp.int32)  # [B, K]
+    sel_c = jnp.clip(sel, 0, x.shape[1] - 1)
+    idx = sel_c.reshape(sel_c.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, idx, axis=1)
+    new_len = jnp.take_along_axis(sub_len, sel_c, axis=1)
+    # negative selected index = unused beam slot -> empty sequence
+    new_len = jnp.where(sel >= 0, new_len, 0)
+    return {"Out": [out], "LengthOut": [new_len]}
+
+
 @register_op("lod_reset", grad=None, non_diff_inputs=("Y", "Length"))
 def lod_reset(ctx, ins, attrs):
     """Replace a tensor's sequence segmentation (reference lod_reset_op.cc).

@@ -177,8 +177,35 @@ def crop(ctx, ins, attrs):
     x = ins["X"][0]
     offsets = attrs["offsets"]
     shape = attrs["shape"]
-    idx = tuple(slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    # -1 extent = keep the rest of the axis (deferred batch dim)
+    idx = tuple(slice(int(o), None if int(s) == -1 else int(o) + int(s))
+                for o, s in zip(offsets, shape))
     return {"Out": [x[idx]]}
+
+
+@register_op("reverse")
+def reverse(ctx, ins, attrs):
+    """Flip along the given axes (used by v1 rotate_layer; the reference
+    RotateLayer composes transpose+reverse in its CPU/GPU kernels)."""
+    jnp = _j()
+    axes = attrs.get("axis", [0])
+    axes = [int(a) for a in (axes if isinstance(axes, (list, tuple))
+                             else [axes])]
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(axes))]}
+
+
+@register_op("sampling_id", grad=None)
+def sampling_id(ctx, ins, attrs):
+    """Sample one id per row from a multinomial distribution (reference
+    SamplingIdLayer, gserver/layers/SamplingIdLayer.cpp): X [B, C] holds
+    probabilities (rows sum to 1)."""
+    import jax
+
+    jnp = _j()
+    x = ins["X"][0]
+    logp = jnp.log(jnp.clip(x.astype(jnp.float32), 1e-30, None))
+    ids = jax.random.categorical(ctx.rng(attrs), logp, axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
 
 
 @register_op("gather", non_diff_inputs=("Index",))
@@ -186,6 +213,17 @@ def gather(ctx, ins, attrs):
     jnp = _j()
     x, index = ins["X"][0], ins["Index"][0]
     return {"Out": [jnp.take(x, index.astype(jnp.int32), axis=0)]}
+
+
+@register_op("beam_gather", non_diff_inputs=("Index",))
+def beam_gather(ctx, ins, attrs):
+    """Reorder beam-lane state by parent pointers: X [B,K,...],
+    Index [B,K] -> Out[b,k] = X[b, Index[b,k]] (the state shuffle after a
+    beam_search step; reference did this via LoD offsets)."""
+    jnp = _j()
+    x, idx = ins["X"][0], ins["Index"][0].astype(jnp.int32)
+    full = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.take_along_axis(x, full, axis=1)]}
 
 
 @register_op("scatter", non_diff_inputs=("Ids",))

@@ -23,9 +23,10 @@ from .evaluators import (auc_evaluator, chunk_evaluator,  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import LayerOutput  # noqa: F401
 from .networks import (bidirectional_gru, bidirectional_lstm,  # noqa: F401
-                       img_conv_group, sequence_conv_pool, simple_attention,
-                       simple_gru, simple_img_conv_pool, simple_lstm,
-                       vgg_16_network)
+                       gru_group, gru_unit, img_conv_group, lstmemory_group,
+                       lstmemory_unit, sequence_conv_pool, simple_attention,
+                       simple_gru, simple_gru2, simple_img_conv_pool,
+                       simple_lstm, text_conv_pool, vgg_16_network)
 from .optimizers import (AdaDeltaOptimizer, AdaGradOptimizer,  # noqa: F401
                          AdamOptimizer, AdamaxOptimizer,
                          DecayedAdaGradOptimizer, MomentumOptimizer,

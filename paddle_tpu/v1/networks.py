@@ -140,3 +140,117 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
                      attrs={"dim": 1, "keep_dim": False})
     return _wrap(ctx, "attention",
                  size=getattr(encoded_sequence, "size", None))
+
+
+# --- recurrent-group presets (reference networks.py lstmemory_unit/group,
+# gru_unit/group — the step-level building blocks of attention decoders) ----
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None, state_act=None,
+                   input_proj_bias_attr=None, input_proj_layer_attr=None,
+                   lstm_bias_attr=None, lstm_layer_attr=None):
+    """One LSTM time step for use inside recurrent_group (reference
+    networks.py lstmemory_unit): projection of [x_t, h_{t-1}] into 4H then
+    lstm_step_layer; cell state rides a named memory."""
+    from .layers import (full_matrix_projection, get_output_layer,
+                         identity_projection, lstm_step_layer, memory,
+                         mixed_layer)
+    from ..framework import unique_name
+
+    if size is None:
+        size = input.size // 4
+    name = name or unique_name.generate("lstmemory_unit")
+    if out_memory is None:
+        out_mem = memory(name=name, size=size)
+    else:
+        out_mem = out_memory
+
+    state_mem = memory(name=f"{name}_state", size=size)
+    with mixed_layer(name=f"{name}_input_recurrent", size=size * 4,
+                     bias_attr=input_proj_bias_attr) as m:
+        m += identity_projection(input=input)
+        m += full_matrix_projection(input=out_mem, param_attr=param_attr)
+    lstm_out = lstm_step_layer(
+        name=name, input=m, state=state_mem, size=size,
+        bias_attr=lstm_bias_attr, act=act, gate_act=gate_act,
+        state_act=state_act)
+    get_output_layer(name=f"{name}_state", input=lstm_out, arg_name="state")
+    return lstm_out
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None, gate_act=None,
+                    state_act=None, input_proj_bias_attr=None,
+                    input_proj_layer_attr=None, lstm_bias_attr=None,
+                    lstm_layer_attr=None):
+    """recurrent_group form of LSTM (reference networks.py lstmemory_group):
+    per-step states stay accessible, unlike the fused lstmemory."""
+    from .layers import recurrent_group
+
+    def __lstm_step__(ipt):
+        return lstmemory_unit(
+            input=ipt, name=name, size=size, out_memory=out_memory,
+            act=act, gate_act=gate_act, state_act=state_act,
+            param_attr=param_attr, input_proj_bias_attr=input_proj_bias_attr,
+            lstm_bias_attr=lstm_bias_attr)
+
+    return recurrent_group(
+        name=f"{name}_recurrent_group" if name else None,
+        step=__lstm_step__, reverse=reverse, input=input)
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None, gru_bias_attr=None,
+             gru_param_attr=None, act=None, gate_act=None,
+             gru_layer_attr=None, naive=False):
+    """One GRU time step for use inside recurrent_group (reference
+    networks.py gru_unit): input must already be the 3H projection."""
+    from .layers import gru_step_layer, gru_step_naive_layer, memory
+    from ..framework import unique_name
+
+    if size is None:
+        size = input.size // 3
+    name = name or unique_name.generate("gru_unit")
+    out_mem = memory(name=name, size=size, boot_layer=memory_boot)
+    step = gru_step_naive_layer if naive else gru_step_layer
+    return step(name=name, size=size, bias_attr=gru_bias_attr,
+                param_attr=gru_param_attr, act=act, gate_act=gate_act,
+                input=input, output_mem=out_mem)
+
+
+def gru_group(input, memory_boot=None, size=None, name=None,
+              reverse=False, gru_bias_attr=None, gru_param_attr=None,
+              act=None, gate_act=None, gru_layer_attr=None, naive=False):
+    """recurrent_group form of GRU (reference networks.py gru_group)."""
+    from .layers import recurrent_group
+
+    def __gru_step__(ipt):
+        return gru_unit(input=ipt, name=name, memory_boot=memory_boot,
+                        size=size, gru_bias_attr=gru_bias_attr,
+                        gru_param_attr=gru_param_attr, act=act,
+                        gate_act=gate_act, naive=naive)
+
+    return recurrent_group(
+        name=f"{name}_recurrent_group" if name else None,
+        step=__gru_step__, reverse=reverse, input=input)
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=False, gru_param_attr=None,
+                gru_bias_attr=True, act=None, gate_act=None, **kw):
+    """simple_gru2 (reference networks.py): mixed projection + gru_group —
+    same math as grumemory with the group-form building blocks."""
+    from .layers import full_matrix_projection, mixed_layer
+
+    proj = mixed_layer(size=size * 3, input=[full_matrix_projection(
+        input=input, size=size * 3, param_attr=mixed_param_attr)],
+        bias_attr=mixed_bias_attr)
+    return gru_group(input=proj, size=size, name=name, reverse=reverse,
+                     gru_bias_attr=gru_bias_attr,
+                     gru_param_attr=gru_param_attr, act=act,
+                     gate_act=gate_act)
+
+
+def text_conv_pool(input, context_len, hidden_size, act=None, **kw):
+    """text_conv_pool (reference networks.py): alias of sequence_conv_pool."""
+    return sequence_conv_pool(input, context_len=context_len,
+                              hidden_size=hidden_size, act=act, **kw)

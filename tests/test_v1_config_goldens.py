@@ -1,0 +1,474 @@
+"""v1 config-golden corpus (reference trainer_config_helpers/tests/configs —
+58 golden configs checked by protostr diff).  Each builder mirrors one
+reference config; the golden contract here is (a) the config parses into a
+Program, (b) the op-type sequence survives the proto round-trip unchanged,
+(c) the expected key op types are present.  That is the same stability
+guarantee the protostr goldens gave, expressed against the Program IR."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import proto_io
+from paddle_tpu.v1 import layers as v1
+from paddle_tpu.v1.activations import (ReluActivation, SigmoidActivation,
+                                       SoftmaxActivation, TanhActivation)
+from paddle_tpu.v1 import networks as v1nets
+
+
+def _seq(name, size, dtype="float32"):
+    return v1.data_layer(name, size=size, dtype=dtype, seq=True)
+
+
+def _img(name, c, h, w):
+    return v1.data_layer(name, size=c * h * w, height=h, width=w)
+
+
+# --- one builder per reference config file ---------------------------------
+
+def cfg_img_layers():
+    img = _img("image", 1, 16, 16)
+    conv = v1.img_conv_layer(img, filter_size=3, num_filters=4, padding=1,
+                             act=ReluActivation())
+    bn = v1.batch_norm_layer(conv, act=ReluActivation())
+    pool = v1.img_pool_layer(bn, pool_size=2, stride=2)
+    norm = v1.img_cmrnorm_layer(pool, size=5)
+    return norm, {"conv2d", "batch_norm", "pool2d", "lrn"}
+
+
+def cfg_img_trans_layers():
+    img = _img("timage", 4, 8, 8)
+    convt = v1.img_conv_layer(img, filter_size=3, num_filters=2, stride=2,
+                              trans=True)
+    return convt, {"conv2d_transpose"}
+
+
+def cfg_last_first_seq():
+    s = _seq("lfseq", 6)
+    a = v1.first_seq(s)
+    b = v1.last_seq(s)
+    return v1.concat_layer([a, b]), {"sequence_pool", "concat"}
+
+
+def cfg_layer_activations():
+    x = v1.data_layer("actx", size=8)
+    outs = []
+    for act in (TanhActivation(), SigmoidActivation(),
+                ReluActivation(), SoftmaxActivation()):
+        outs.append(v1.fc_layer(x, size=4, act=act))
+    return v1.addto_layer(outs), {"tanh", "sigmoid", "relu", "softmax"}
+
+
+def cfg_math_ops():
+    x = v1.data_layer("mx", size=4)
+    y = v1.slope_intercept_layer(x, slope=2.0, intercept=1.0)
+    z = v1.power_layer(y, v1.data_layer("mw", size=1))
+    c = v1.clip_layer(z, min=-5.0, max=5.0)
+    return c, {"scale", "elementwise_pow", "clip"}
+
+
+def cfg_projections():
+    a = v1.data_layer("pja", size=6)
+    ids = v1.data_layer("pjids", size=10, dtype="int64")
+    m = v1.mixed_layer(size=6, input=[
+        v1.full_matrix_projection(a, size=6),
+        v1.identity_projection(a),
+        v1.table_projection(ids, size=6),
+        v1.dotmul_projection(a),
+    ], act=TanhActivation())
+    return m, {"mul", "lookup_table", "elementwise_mul", "tanh"}
+
+
+def cfg_shared_fc():
+    a = v1.data_layer("sfa", size=4)
+    b = v1.data_layer("sfb", size=4)
+    fa = v1.fc_layer(a, size=3)
+    fb = v1.fc_layer(b, size=3)
+    return v1.addto_layer([fa, fb]), {"mul", "elementwise_add"}
+
+
+def cfg_shared_gru():
+    s = _seq("sgru", 6)
+    g1 = v1nets.simple_gru(s, size=4)
+    return v1.last_seq(g1), {"gru"}
+
+
+def cfg_shared_lstm():
+    s = _seq("slstm", 6)
+    l1 = v1nets.simple_lstm(s, size=4)
+    return v1.last_seq(l1), {"lstm"}
+
+
+def cfg_simple_rnn_layers():
+    s = _seq("srl", 8)
+    r = v1.recurrent_layer(s, act=TanhActivation())
+    proj4 = v1.fc_layer(s, size=32, bias_attr=False)
+    l = v1.lstmemory(proj4, size=8)
+    proj3 = v1.fc_layer(s, size=24, bias_attr=False)
+    g = v1.grumemory(proj3, size=8, reverse=True)
+    return v1.addto_layer([v1.last_seq(r), v1.last_seq(l), v1.last_seq(g)]), \
+        {"static_rnn", "lstm", "gru", "sequence_reverse"}
+
+
+def cfg_test_BatchNorm3D():
+    vol = fluid.layers.data("bn3vol", shape=[2, 4, 4, 4], dtype="float32")
+    lo = v1.LayerOutput(vol, "data", size=128)
+    bn = v1.batch_norm_layer(lo)
+    return bn, {"batch_norm"}
+
+
+def cfg_test_bi_grumemory():
+    s = _seq("bigru", 6)
+    return v1nets.bidirectional_gru(s, size=4), {"gru", "sequence_reverse"}
+
+
+def cfg_test_bilinear_interp():
+    img = _img("bili", 2, 4, 4)
+    conv = v1.img_conv_layer(img, filter_size=3, num_filters=2, padding=1)
+    up = v1.bilinear_interp_layer(conv, out_size_x=8, out_size_y=8)
+    return up, {"bilinear_interp"}
+
+
+def cfg_test_clip_layer():
+    x = v1.data_layer("clx", size=4)
+    return v1.clip_layer(x, min=-1.0, max=1.0), {"clip"}
+
+
+def cfg_test_conv3d_layer():
+    vol = fluid.layers.data("c3vol", shape=[1, 4, 4, 4], dtype="float32")
+    lo = v1.LayerOutput(vol, "data", size=64)
+    return v1.img_conv3d_layer(lo, filter_size=3, num_filters=2, padding=1), \
+        {"conv3d"}
+
+
+def cfg_test_deconv3d_layer():
+    vol = fluid.layers.data("d3vol", shape=[2, 4, 4, 4], dtype="float32")
+    lo = v1.LayerOutput(vol, "data", size=128)
+    return v1.img_conv3d_layer(lo, filter_size=2, num_filters=1, stride=2,
+                               trans=True), {"conv3d_transpose"}
+
+
+def cfg_test_cost_layers():
+    score = v1.data_layer("cs_sc", size=1)
+    left = v1.data_layer("cs_l", size=1)
+    right = v1.data_layer("cs_r", size=1)
+    lab01 = v1.data_layer("cs_lab", size=1)
+    probs = v1.fc_layer(v1.data_layer("cs_x", size=6), size=4,
+                        act=SoftmaxActivation())
+    ilab = v1.data_layer("cs_il", size=1, dtype="int64")
+    costs = [
+        v1.classification_cost(probs, ilab),
+        v1.cross_entropy(probs, ilab),
+        v1.cross_entropy_with_selfnorm(probs, ilab),
+        v1.huber_regression_cost(score, lab01),
+        v1.huber_classification_cost(score, lab01),
+        v1.rank_cost(left, right, lab01),
+        v1.multi_binary_label_cross_entropy(
+            v1.fc_layer(probs, size=4), v1.data_layer("cs_ml", size=4)),
+        v1.sum_cost(score),
+        v1.smooth_l1_cost(score, lab01),
+    ]
+    return v1.addto_layer(costs), {
+        "cross_entropy", "cross_entropy_selfnorm", "huber_loss",
+        "huber_classification", "rank_loss",
+        "sigmoid_cross_entropy_with_logits", "reduce_sum", "smooth_l1_loss"}
+
+
+def cfg_test_cost_layers_with_weight():
+    x = v1.fc_layer(v1.data_layer("cw_x", size=4), size=2,
+                    act=SoftmaxActivation())
+    lab = v1.data_layer("cw_l", size=1, dtype="int64")
+    return v1.classification_cost(x, lab), {"cross_entropy", "mean"}
+
+
+def cfg_test_crop():
+    img = _img("crimg", 1, 8, 8)
+    pad = v1.pad_layer(img, pad_h=[1, 1], pad_w=[1, 1])
+    return v1.crop_layer(pad, offset=[1, 1], shape=[8, 8]), {"pad", "crop"}
+
+
+def cfg_test_detection_output_layer():
+    feat = _img("do_f", 4, 4, 4)
+    img = _img("do_i", 3, 16, 16)
+    pb = v1.priorbox_layer(feat, img, aspect_ratio=[2.0],
+                           variance=[0.1, 0.1, 0.2, 0.2], min_size=[4.0])
+    loc = v1.data_layer("do_loc", size=4)
+    conf = v1.data_layer("do_conf", size=8)
+    return v1.detection_output_layer(loc, conf, pb, num_classes=2), \
+        {"prior_box", "detection_output"}
+
+
+def cfg_test_multibox_loss_layer():
+    feat = _img("mb_f", 4, 4, 4)
+    img = _img("mb_i", 3, 16, 16)
+    pb = v1.priorbox_layer(feat, img, aspect_ratio=[2.0],
+                           variance=[0.1, 0.1, 0.2, 0.2], min_size=[4.0])
+    loc = v1.data_layer("mb_loc", size=4)
+    conf = v1.data_layer("mb_conf", size=8)
+    lab = v1.data_layer("mb_lab", size=6)
+    return v1.multibox_loss_layer(loc, conf, pb, lab, num_classes=2), \
+        {"prior_box", "multibox_loss"}
+
+
+def cfg_test_dot_prod_layer():
+    a = v1.data_layer("dpa", size=4)
+    b = v1.data_layer("dpb", size=4)
+    return v1.dot_prod_layer(a, b), {"elementwise_mul", "reduce_sum"}
+
+
+def cfg_test_expand_layer():
+    d = v1.data_layer("exd", size=4)
+    s = _seq("exs", 4)
+    return v1.expand_layer(d, s), {"sequence_expand"}
+
+
+def cfg_test_factorization_machine():
+    x = v1.data_layer("fmx", size=8)
+    return v1.factorization_machine(x, factor_size=3), \
+        {"factorization_machine"}
+
+
+def cfg_test_fc():
+    x = v1.data_layer("fcx", size=8)
+    h = v1.fc_layer(x, size=4, act=TanhActivation())
+    return v1.fc_layer(h, size=2), {"mul", "tanh"}
+
+
+def cfg_test_gated_unit_layer():
+    x = v1.data_layer("gux2", size=6)
+    return v1.gated_unit_layer(x, size=3), {"sigmoid", "elementwise_mul"}
+
+
+def cfg_test_grumemory_layer():
+    s = _seq("grml", 6)
+    proj = v1.fc_layer(s, size=12, bias_attr=False)
+    return v1.grumemory(proj, size=4), {"gru"}
+
+
+def cfg_test_hsigmoid():
+    x = v1.data_layer("hsx", size=8)
+    lab = v1.data_layer("hsl", size=1, dtype="int64")
+    return v1.hsigmoid(x, lab, num_classes=6), {"hsigmoid"}
+
+
+def cfg_test_kmax_seq_socre_layer():
+    s = _seq("kmx", 1)
+    return v1.kmax_seq_score_layer(s, beam_size=3), {"kmax_seq_score"}
+
+
+def cfg_test_l2_distance_layer():
+    a = v1.data_layer("l2a", size=5)
+    b = v1.data_layer("l2b", size=5)
+    return v1.l2_distance_layer(a, b), {"squared_l2_distance", "sqrt"}
+
+
+def cfg_test_lstmemory_layer():
+    s = _seq("lml", 4)
+    proj = v1.fc_layer(s, size=16, bias_attr=False)
+    return v1.lstmemory(proj, size=4, reverse=True), \
+        {"lstm", "sequence_reverse"}
+
+
+def cfg_test_maxout():
+    img = _img("moimg", 8, 4, 4)
+    conv = v1.img_conv_layer(img, filter_size=3, num_filters=8, padding=1)
+    return v1.maxout_layer(conv, groups=2), {"maxout"}
+
+
+def cfg_test_multiplex_layer():
+    ids = v1.data_layer("mpid", size=1, dtype="int64")
+    a = v1.data_layer("mpa", size=4)
+    b = v1.data_layer("mpb", size=4)
+    c = v1.data_layer("mpc", size=4)
+    return v1.multiplex_layer([ids, a, b, c]), {"multiplex"}
+
+
+def cfg_test_ntm_layers():
+    w = v1.data_layer("ntw", size=1)
+    a = v1.data_layer("nta", size=6)
+    b = v1.data_layer("ntb", size=6)
+    t = v1.tensor_layer(a, b, size=4)
+    cs = v1.cos_sim(a, b)
+    conv = v1.conv_shift_layer(a, v1.data_layer("ntc", size=3))
+    interp = v1.interpolation_layer([a, b], w)
+    return v1.addto_layer([v1.fc_layer(t, size=6), v1.fc_layer(cs, size=6),
+                           v1.fc_layer(conv, size=6), interp]), \
+        {"bilinear_tensor_product", "cos_sim", "conv_shift"}
+
+
+def cfg_test_pad():
+    img = _img("pdimg", 2, 4, 4)
+    return v1.pad_layer(img, pad_c=[1, 1], pad_h=[0, 0], pad_w=[2, 2]), \
+        {"pad"}
+
+
+def cfg_test_pooling3D_layer():
+    vol = fluid.layers.data("p3vol", shape=[2, 4, 4, 4], dtype="float32")
+    lo = v1.LayerOutput(vol, "data", size=128)
+    return v1.img_pool3d_layer(lo, pool_size=2, stride=2), {"pool3d"}
+
+
+def cfg_test_prelu_layer():
+    img = _img("prlimg", 3, 4, 4)
+    return v1.prelu_layer(img), {"prelu"}
+
+
+def cfg_test_print_layer():
+    x = v1.data_layer("prx2", size=4)
+    return v1.printer_layer(x), {"print"}
+
+
+def cfg_test_recursive_topology():
+    x = v1.data_layer("rtx", size=4)
+    out = x
+    for _ in range(8):
+        out = v1.addto_layer([out, out])
+    return out, {"elementwise_add"}
+
+
+def cfg_test_repeat_layer():
+    x = v1.data_layer("rpx", size=4)
+    a = v1.repeat_layer(x, 2, as_row_vector=True)
+    b = v1.repeat_layer(x, 2, as_row_vector=False)
+    return v1.concat_layer([a, b]), {"concat", "expand"}
+
+
+def cfg_test_resize_layer():
+    x = v1.data_layer("rsx", size=16)
+    return v1.resize_layer(x, size=4), {"reshape"}
+
+
+def cfg_test_rnn_group():
+    s = _seq("rgs", 4)
+
+    def step(x_t):
+        mem = v1.memory(name="rg_h", size=4)
+        return v1.fc_layer([x_t, mem], size=4, act=TanhActivation(),
+                           name="rg_h")
+
+    out = v1.recurrent_group(step=step, input=s)
+    return v1.last_seq(out), {"static_rnn"}
+
+
+def cfg_test_roi_pool_layer():
+    img = _img("rpimg", 4, 8, 8)
+    rois = v1.data_layer("rprois", size=5)
+    conv = v1.img_conv_layer(img, filter_size=3, num_filters=4, padding=1)
+    return v1.roi_pool_layer(conv, rois, pooled_width=2, pooled_height=2,
+                             spatial_scale=0.5), {"roi_pool"}
+
+
+def cfg_test_row_conv():
+    s = _seq("rcs", 6)
+    return v1.row_conv_layer(s, context_len=2), {"row_conv"}
+
+
+def cfg_test_row_l2_norm_layer():
+    x = v1.data_layer("rlnx", size=6)
+    return v1.row_l2_norm_layer(x), {"norm"}
+
+
+def cfg_test_scale_shift_layer():
+    x = v1.data_layer("sshx", size=4)
+    return v1.scale_shift_layer(x), {"elementwise_mul", "elementwise_add"}
+
+
+def cfg_test_scale_sub_region_layer():
+    img = _img("ssrimg", 2, 4, 4)
+    idx = v1.data_layer("ssridx", size=6)
+    return v1.scale_sub_region_layer(img, idx, value=2.0), \
+        {"scale_sub_region"}
+
+
+def cfg_test_seq_concat_reshape():
+    a = _seq("scra", 4)
+    b = _seq("scrb", 4)
+    cc = v1.seq_concat_layer(a, b)
+    return v1.seq_reshape_layer(cc, reshape_size=2), \
+        {"sequence_concat_time", "sequence_reshape"}
+
+
+def cfg_test_seq_slice_layer():
+    s = _seq("ssls", 1)
+    st = v1.data_layer("sslst", size=1, dtype="int64")
+    en = v1.data_layer("sslen", size=1, dtype="int64")
+    return v1.seq_slice_layer(s, st, en), {"sequence_slice"}
+
+
+def cfg_test_sequence_pooling():
+    s = _seq("sqp", 6)
+    outs = [v1.pooling_layer(s, pooling_type=pt)
+            for pt in (v1.MaxPooling(), v1.AvgPooling())]
+    return v1.concat_layer(outs), {"sequence_pool"}
+
+
+def cfg_test_smooth_l1():
+    x = v1.data_layer("smx", size=4)
+    y = v1.data_layer("smy", size=4)
+    return v1.smooth_l1_cost(x, y), {"smooth_l1_loss"}
+
+
+def cfg_test_split_datasource():
+    # data-config-only golden in the reference; the graph side is one input
+    return v1.fc_layer(v1.data_layer("sdx", size=4), size=2), {"mul"}
+
+
+def cfg_test_spp_layer():
+    img = _img("sppimg", 2, 8, 8)
+    return v1.spp_layer(img, pyramid_height=2), {"spp"}
+
+
+def cfg_test_sub_nested_seq_select_layer():
+    x = fluid.layers.data("snsx", shape=[3, 2, 2], dtype="float32")
+    from paddle_tpu.layers.sequence import _set_length
+
+    fluid.layers.data("snsl", shape=[3], dtype="int32")
+    _set_length(x, "snsl")
+    lo = v1.LayerOutput(x, "data", size=2)
+    sel = v1.data_layer("snsel", size=2, dtype="int64")
+    return v1.sub_nested_seq_layer(lo, sel), {"sub_nested_seq"}
+
+
+def cfg_unused_layers():
+    p = v1.fc_layer(v1.data_layer("ulx", size=4), size=3,
+                    act=SoftmaxActivation())
+    sid = v1.sampling_id_layer(p)
+    return v1.eos_layer(sid, eos_id=2), {"sampling_id", "equal"}
+
+
+def cfg_util_layers():
+    a = v1.data_layer("uta", size=4)
+    b = v1.data_layer("utb", size=4)
+    s = v1.addto_layer([a, b])
+    c = v1.concat_layer([a, b])
+    t = v1.trans_layer(v1.data_layer("utt", size=4))
+    return v1.addto_layer([v1.fc_layer(s, size=2), v1.fc_layer(c, size=2)]), \
+        {"elementwise_add", "concat", "transpose"}
+
+
+def cfg_test_lambda_cost():
+    s = _seq("lcs", 1)
+    sc = _seq("lcsc", 1)
+    return v1.lambda_cost(s, sc, NDCG_num=3), {"lambda_rank"}
+
+
+CONFIGS = [v for k, v in sorted(globals().items()) if k.startswith("cfg_")]
+
+
+@pytest.mark.parametrize("builder", CONFIGS,
+                         ids=[f.__name__[4:] for f in CONFIGS])
+def test_config_golden(builder):
+    fluid.reset()
+    out, expected_ops = builder()
+    prog = v1.parse_network(out)
+    types = [op.type for op in prog.global_block().ops]
+    missing = expected_ops - set(
+        op.type for b in prog.blocks for op in b.ops)
+    assert not missing, f"ops missing from parsed config: {missing}"
+    # proto round-trip: the serialized interchange form is stable (the
+    # reference's protostr golden contract)
+    blob = proto_io.serialize_program(prog)
+    prog2 = proto_io.parse_program(blob)
+    assert [op.type for op in prog2.global_block().ops] == types
+    for b1, b2 in zip(prog.blocks, prog2.blocks):
+        assert [o.type for o in b1.ops] == [o.type for o in b2.ops]

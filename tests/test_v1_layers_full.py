@@ -1,0 +1,378 @@
+"""v1 layer-API completeness tests (round-2 additions): the remaining
+reference *_layer functions — elementwise/shape utilities, image ops,
+detection wrappers, sequence slicing, and the recurrent-group machinery
+(reference trainer_config_helpers/layers.py + tests/configs goldens)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDTensor
+from paddle_tpu.v1 import layers as v1
+
+
+def _run(feeds, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=list(fetch))
+
+
+# --- elementwise / shape ----------------------------------------------------
+
+def test_repeat_layer_both_modes():
+    x = v1.data_layer("rx", size=3)
+    row = v1.repeat_layer(x, 2, as_row_vector=True)
+    el = v1.repeat_layer(x, 2, as_row_vector=False)
+    v = np.array([[1.0, 2.0, 3.0]], np.float32)
+    o1, o2 = _run({"rx": v}, [row.var, el.var])
+    np.testing.assert_allclose(o1, [[1, 2, 3, 1, 2, 3]])
+    np.testing.assert_allclose(o2, [[1, 1, 2, 2, 3, 3]])
+    assert row.size == 6
+
+
+def test_resize_and_rotate_and_switch_order():
+    img = v1.data_layer("ri", size=2 * 2 * 3, height=2, width=3)  # [B,2,2,3]
+    rot = v1.rotate_layer(img, height=2, width=3)
+    sw = v1.switch_order_layer(img, reshape_axis=3)
+    rs = v1.resize_layer(img, size=6)
+    x = np.arange(12, dtype=np.float32).reshape(1, 2, 2, 3)
+    o_rot, o_sw, o_rs = _run({"ri": x}, [rot.var, sw.var, rs.var])
+    # clockwise 90°: y[j, i] = x[M-1-i, j] for each channel (M=2 rows)
+    want = np.zeros((1, 2, 3, 2), np.float32)
+    for c in range(2):
+        for j in range(3):
+            for i in range(2):
+                want[0, c, j, i] = x[0, c, 2 - 1 - i, j]
+    np.testing.assert_allclose(o_rot, want)
+    assert o_sw.shape == (1, 2, 3, 2)  # NCHW -> NHWC
+    np.testing.assert_allclose(o_sw[0, :, :, 0], x[0, 0])
+    assert o_rs.shape == (2, 6)
+
+
+def test_norm_layers():
+    x = v1.data_layer("nx", size=4)
+    s1 = v1.sum_to_one_norm_layer(x)
+    l2 = v1.row_l2_norm_layer(x)
+    v = np.array([[1.0, 1.0, 2.0, 4.0]], np.float32)
+    o1, o2 = _run({"nx": v}, [s1.var, l2.var])
+    np.testing.assert_allclose(o1.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(o2), 1.0, rtol=1e-4)
+
+
+def test_dot_out_prod_l2_distance():
+    a = v1.data_layer("pa", size=3)
+    b = v1.data_layer("pb", size=3)
+    dp = v1.dot_prod_layer(a, b)
+    op = v1.out_prod_layer(a, b)
+    l2 = v1.l2_distance_layer(a, b)
+    va = np.array([[1.0, 2.0, 3.0]], np.float32)
+    vb = np.array([[4.0, 5.0, 6.0]], np.float32)
+    o_dp, o_op, o_l2 = _run({"pa": va, "pb": vb},
+                            [dp.var, op.var, l2.var])
+    np.testing.assert_allclose(o_dp, [[32.0]])
+    np.testing.assert_allclose(o_op.reshape(3, 3), np.outer(va[0], vb[0]))
+    np.testing.assert_allclose(o_l2, [[np.sqrt(27.0)]], rtol=1e-5)
+
+
+def test_linear_comb_and_multiplex():
+    w = v1.data_layer("lw", size=2)
+    vec = v1.data_layer("lv", size=6)
+    lc = v1.linear_comb_layer(weights=w, vectors=vec, size=3)
+    ww = np.array([[2.0, 3.0]], np.float32)
+    vv = np.arange(6, dtype=np.float32).reshape(1, 6)
+    (o,) = _run({"lw": ww, "lv": vv}, [lc.var])
+    want = 2.0 * vv[0, :3] + 3.0 * vv[0, 3:]
+    np.testing.assert_allclose(o[0], want)
+
+    fluid.reset()
+    ids = v1.data_layer("mid", size=1, dtype="int64")
+    c1 = v1.data_layer("mc1", size=2)
+    c2 = v1.data_layer("mc2", size=2)
+    mx = v1.multiplex_layer([ids, c1, c2])
+    (o,) = _run({"mid": np.array([[1], [0]], np.int64),
+                 "mc1": np.array([[1, 1], [2, 2]], np.float32),
+                 "mc2": np.array([[9, 9], [8, 8]], np.float32)}, [mx.var])
+    np.testing.assert_allclose(o, [[9, 9], [2, 2]])
+
+
+def test_scale_shift_trains_and_eos_sampling():
+    x = v1.data_layer("ssx", size=4)
+    ss = v1.scale_shift_layer(x)
+    (o,) = _run({"ssx": np.ones((2, 4), np.float32)}, [ss.var])
+    assert o.shape == (2, 4)
+
+    fluid.reset()
+    ids = v1.data_layer("eid", size=1, dtype="int64")
+    eos = v1.eos_layer(ids, eos_id=2)
+    (o,) = _run({"eid": np.array([[2], [1]], np.int64)}, [eos.var])
+    assert o.reshape(-1).tolist() == [1, 0]
+
+    fluid.reset()
+    p = v1.data_layer("sp", size=3)
+    sid = v1.sampling_id_layer(p)
+    probs = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], np.float32)
+    (o,) = _run({"sp": probs}, [sid.var])
+    assert o.tolist() == [1, 2]  # deterministic rows
+
+
+# --- image ------------------------------------------------------------------
+
+def test_pad_crop_roundtrip():
+    img = v1.data_layer("pimg", size=1 * 2 * 2, height=2, width=2)
+    padded = v1.pad_layer(img, pad_c=[0, 0], pad_h=[1, 1], pad_w=[1, 1])
+    cropped = v1.crop_layer(padded, offset=[1, 1], shape=[2, 2], axis=2)
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    o_pad, o_crop = _run({"pimg": x}, [padded.var, cropped.var])
+    assert o_pad.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(o_crop, x)
+
+
+def test_bilinear_interp_align_corners():
+    img = v1.data_layer("bimg", size=1 * 2 * 2, height=2, width=2)
+    up = v1.bilinear_interp_layer(img, out_size_x=3, out_size_y=3)
+    x = np.array([[[[0.0, 2.0], [4.0, 6.0]]]], np.float32)
+    (o,) = _run({"bimg": x}, [up.var])
+    # align-corners: corners exact, center = mean
+    np.testing.assert_allclose(o[0, 0, 0, 0], 0.0)
+    np.testing.assert_allclose(o[0, 0, 2, 2], 6.0)
+    np.testing.assert_allclose(o[0, 0, 1, 1], 3.0)
+
+
+def test_cross_channel_norm_and_prelu():
+    img = v1.data_layer("cimg", size=2 * 2 * 2, height=2, width=2)
+    n = v1.cross_channel_norm_layer(img)
+    pr = v1.prelu_layer(img)
+    x = np.ones((1, 2, 2, 2), np.float32)
+    x[:, 1] = -1.0
+    o_n, o_p = _run({"cimg": x}, [n.var, pr.var])
+    # per-position channel vector (1,-1)/sqrt(2) * scale(=1 init)
+    np.testing.assert_allclose(np.abs(o_n), 1 / np.sqrt(2), rtol=1e-4)
+    np.testing.assert_allclose(o_p[0, 0], 1.0)        # positive passthrough
+    np.testing.assert_allclose(o_p[0, 1], -0.25)      # alpha=0.25 init
+
+
+def test_scale_sub_region():
+    img = v1.data_layer("srimg", size=1 * 2 * 2, height=2, width=2)
+    idx = v1.data_layer("sridx", size=6)
+    out = v1.scale_sub_region_layer(img, idx, value=10.0)
+    x = np.ones((1, 1, 2, 2), np.float32)
+    # scale channel 1, row 1, col 1..2 (1-based)
+    ind = np.array([[1, 1, 1, 1, 1, 2]], np.float32)
+    (o,) = _run({"srimg": x, "sridx": ind}, [out.var])
+    np.testing.assert_allclose(o[0, 0], [[10.0, 10.0], [1.0, 1.0]])
+
+
+def test_spp_pool3d_conv3d_layers():
+    img = v1.data_layer("spimg", size=1 * 4 * 4, height=4, width=4)
+    sp = v1.spp_layer(img, pyramid_height=2)
+    x = np.random.RandomState(0).rand(2, 1, 4, 4).astype(np.float32)
+    (o,) = _run({"spimg": x}, [sp.var])
+    assert o.shape == (2, 5)  # 1 + 4 bins
+
+    fluid.reset()
+    vol = fluid.layers.data("vol", shape=[1, 4, 4, 4], dtype="float32")
+    vlo = v1.LayerOutput(vol, "data", size=64)
+    c3 = v1.img_conv3d_layer(vlo, filter_size=3, num_filters=2, padding=1)
+    p3 = v1.img_pool3d_layer(c3, pool_size=2, stride=2)
+    xv = np.random.RandomState(1).rand(1, 1, 4, 4, 4).astype(np.float32)
+    o_c, o_p = _run({"vol": xv}, [c3.var, p3.var])
+    assert o_c.shape == (1, 2, 4, 4, 4)
+    assert o_p.shape == (1, 2, 2, 2, 2)
+
+
+def test_block_expand_layer():
+    img = v1.data_layer("beimg", size=1 * 2 * 2, height=2, width=2)
+    be = v1.block_expand_layer(img, block_x=1, block_y=1, stride_x=1,
+                               stride_y=1)
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    (o,) = _run({"beimg": x}, [be.var])
+    assert o.shape == (4, 1)  # 4 time steps of 1 feature
+    np.testing.assert_allclose(o.reshape(-1), [0, 1, 2, 3])
+
+
+# --- detection wrappers -----------------------------------------------------
+
+def test_detection_layer_wrappers_build_and_run():
+    feat = v1.data_layer("dfeat", size=4 * 2 * 2, height=2, width=2)
+    img = v1.data_layer("dimg", size=3 * 8 * 8, height=8, width=8)
+    pb = v1.priorbox_layer(feat, img, aspect_ratio=[2.0],
+                           variance=[0.1, 0.1, 0.2, 0.2],
+                           min_size=[4.0], max_size=[])
+    rois = v1.data_layer("drois", size=5)
+    rp = v1.roi_pool_layer(feat, rois, pooled_width=2, pooled_height=2,
+                           spatial_scale=0.25)
+    f = np.random.RandomState(0).rand(1, 4, 2, 2).astype(np.float32)
+    im = np.random.RandomState(1).rand(1, 3, 8, 8).astype(np.float32)
+    rr = np.array([[0, 0, 0, 4, 4]], np.float32)
+    o_pb, o_rp = _run({"dfeat": f, "dimg": im, "drois": rr},
+                      [pb.var, rp.var])
+    assert o_pb.shape[-1] == 4
+    assert o_rp.shape == (1, 4, 2, 2)
+
+
+# --- sequence slicing -------------------------------------------------------
+
+def _seq_feed(name, seqs):
+    return {name: LoDTensor.from_sequences(seqs)}
+
+
+def test_seq_concat_layer_time_axis():
+    a = v1.data_layer("sca", size=2, seq=True)
+    b = v1.data_layer("scb", size=2, seq=True)
+    cc = v1.seq_concat_layer(a, b)
+    last = v1.last_seq(cc)
+    sa = [np.array([[1, 1], [2, 2]], np.float32)]
+    sb = [np.array([[3, 3]], np.float32)]
+    feeds = {}
+    feeds.update(_seq_feed("sca", sa))
+    feeds.update(_seq_feed("scb", sb))
+    o_cc, o_last = _run(feeds, [cc.var, last.var])
+    np.testing.assert_allclose(o_cc[0, :3], [[1, 1], [2, 2], [3, 3]])
+    np.testing.assert_allclose(o_last[0], [3, 3])  # length = 2+1
+
+
+def test_sub_seq_and_seq_slice_and_kmax():
+    x = v1.data_layer("ssq", size=1, seq=True)
+    offs = v1.data_layer("soff", size=1, dtype="int64")
+    szs = v1.data_layer("ssz", size=1, dtype="int64")
+    sub = v1.sub_seq_layer(x, offs, szs)
+    sub_last = v1.last_seq(sub)
+    seqs = [np.array([[10.0], [20.0], [30.0], [40.0]], np.float32)]
+    feeds = _seq_feed("ssq", seqs)
+    feeds["soff"] = np.array([[1]], np.int64)
+    feeds["ssz"] = np.array([[2]], np.int64)
+    (o,) = _run(feeds, [sub_last.var])
+    np.testing.assert_allclose(o[0], [30.0])  # window [20,30], last=30
+
+    fluid.reset()
+    sc = v1.data_layer("ksq", size=1, seq=True)
+    km = v1.kmax_seq_score_layer(sc, beam_size=2)
+    seqs = [np.array([[0.1], [0.9], [0.5]], np.float32)]
+    (o,) = _run(_seq_feed("ksq", seqs), [km.var])
+    assert o[0].tolist() == [1, 2]  # top-2 positions by score
+
+
+def test_sub_nested_seq_layer():
+    # nested: 1 sample, 3 sub-sequences (padded [B,S,T,D]) — select 2
+    x = fluid.layers.data("nsx", shape=[3, 2, 1], dtype="float32")
+    from paddle_tpu.layers.sequence import _set_length
+
+    lv = fluid.layers.data("nsl", shape=[3], dtype="int32")
+    _set_length(x, "nsl")
+    xin = v1.LayerOutput(x, "data", size=1)
+    sel = v1.data_layer("nsel", size=2, dtype="int64")
+    sub = v1.sub_nested_seq_layer(xin, sel)
+    xv = np.arange(6, dtype=np.float32).reshape(1, 3, 2, 1)
+    (o,) = _run({"nsx": xv, "nsl": np.array([[2, 2, 2]], np.int32),
+                 "nsel": np.array([[2, 0]], np.int64)}, [sub.var])
+    np.testing.assert_allclose(o[0, 0], xv[0, 2])
+    np.testing.assert_allclose(o[0, 1], xv[0, 0])
+
+
+# --- recurrent group machinery ----------------------------------------------
+
+def test_recurrent_group_prefix_sum_memory():
+    """memory(name=X) closes over the layer later named X: running sum."""
+    x = v1.data_layer("rgx", size=1, seq=True)
+
+    def step(x_t):
+        mem = v1.memory(name="acc", size=1)
+        return v1.addto_layer([x_t, mem], name="acc")
+
+    out = v1.recurrent_group(step=step, input=x)
+    last = v1.last_seq(out)
+    seqs = [np.array([[1.0], [2.0], [3.0]], np.float32),
+            np.array([[5.0], [5.0]], np.float32)]
+    (o,) = _run(_seq_feed("rgx", seqs), [last.var])
+    np.testing.assert_allclose(o.reshape(-1), [6.0, 10.0])
+
+
+def test_recurrent_group_reverse_and_static_input():
+    x = v1.data_layer("rrx", size=1, seq=True)
+    bias = v1.data_layer("rrb", size=1)
+
+    def step(x_t, b):
+        mem = v1.memory(name="acc2", size=1)
+        s = v1.addto_layer([x_t, mem], name="acc2")
+        return v1.addto_layer([s, b])
+
+    out = v1.recurrent_group(step=step,
+                             input=[x, v1.StaticInput(bias)], reverse=True)
+    first = v1.first_seq(out)
+    seqs = [np.array([[1.0], [2.0], [3.0]], np.float32)]
+    feeds = _seq_feed("rrx", seqs)
+    feeds["rrb"] = np.array([[10.0]], np.float32)
+    (o,) = _run(feeds, [first.var])
+    # reversed accumulation: step sees 3,2,1; first output = 3+2+1 + bias
+    np.testing.assert_allclose(o.reshape(-1), [16.0])
+
+
+def test_recurrent_layer_simple_rnn():
+    x = v1.data_layer("rlx", size=2, seq=True)
+    out = v1.recurrent_layer(x, act=v1.LinearActivation(), bias_attr=False)
+    last = v1.last_seq(out)
+    seqs = [np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)]
+    (o,) = _run(_seq_feed("rlx", seqs), [last.var])
+    assert o.shape == (1, 2) and np.isfinite(o).all()
+
+
+def test_lstmemory_group_trains():
+    from paddle_tpu.v1 import AdamOptimizer, lstmemory_group, settings
+
+    settings(learning_rate=5e-2, learning_method=AdamOptimizer())
+    x = v1.data_layer("lgx", size=3, seq=True)
+    proj = v1.fc_layer(x, size=16, bias_attr=False)  # 4H projection, H=4
+    h = lstmemory_group(proj, size=4, name="lg")
+    pooled = v1.pooling_layer(h, pooling_type=v1.MaxPooling)
+    label = v1.data_layer("lgy", size=1)
+    cost = v1.mse_cost(v1.fc_layer(pooled, size=1), label)
+
+    from paddle_tpu.v1 import optimizer_from_settings
+
+    optimizer_from_settings().minimize(cost.var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(4, 3).astype(np.float32) for _ in range(6)]
+    ys = np.array([[s.sum() > 0] for s in seqs], np.float32)
+    losses = []
+    for _ in range(15):
+        (l,) = exe.run(feed={"lgx": LoDTensor.from_sequences(seqs),
+                             "lgy": ys}, fetch_list=[cost.var])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_gru_group_runs_and_get_output():
+    from paddle_tpu.v1 import gru_group
+
+    x = v1.data_layer("ggx", size=2, seq=True)
+    proj = v1.fc_layer(x, size=6, bias_attr=False)  # 3H, H=2
+    h = gru_group(proj, size=2, name="gg")
+    last = v1.last_seq(h)
+    seqs = [np.random.RandomState(0).randn(3, 2).astype(np.float32)]
+    (o,) = _run(_seq_feed("ggx", seqs), [last.var])
+    assert o.shape == (1, 2) and np.isfinite(o).all()
+
+
+def test_gated_unit_and_row_conv_and_maxid_alias():
+    x = v1.data_layer("gux", size=4)
+    g = v1.gated_unit_layer(x, size=3)
+    assert g.size == 3
+    (o,) = _run({"gux": np.ones((2, 4), np.float32)}, [g.var])
+    assert o.shape == (2, 3)
+
+    fluid.reset()
+    s = v1.data_layer("rcx", size=2, seq=True)
+    rc = v1.row_conv_layer(s, context_len=2)
+    seqs = [np.ones((3, 2), np.float32)]
+    (o,) = _run(_seq_feed("rcx", seqs), [rc.var])
+    assert o.shape[0] == 1 and np.isfinite(o).all()
+    assert v1.maxid_layer is v1.max_id_layer
+
+
+def test_printer_layer_passthrough():
+    x = v1.data_layer("prx", size=2)
+    p = v1.printer_layer(x)
+    (o,) = _run({"prx": np.ones((1, 2), np.float32)}, [p.var])
+    np.testing.assert_allclose(o, [[1.0, 1.0]])
