@@ -1,11 +1,22 @@
 """Dataset plumbing (reference v2/dataset/common.py: DATA_HOME, download
-cache, cluster_files_reader)."""
+cache, cluster_files_reader).
+
+`download(url, module_name, md5sum)` is the reference's md5-verified fetch
+(v2/dataset/common.py:61): the file lands in DATA_HOME/<module_name>/ and is
+re-fetched only when absent or corrupt.  `fetch()` is the tolerant variant
+the loaders use: on a network failure (this build often runs zero-egress) it
+returns None and the loader falls back to its synthetic surrogate, recording
+the choice in DATA_MODE so tests/users can see which mode actually ran.
+"""
 
 from __future__ import annotations
 
 import glob
+import hashlib
 import os
 import pickle
+import shutil
+import sys
 
 import numpy as np
 
@@ -13,6 +24,16 @@ DATA_HOME = os.environ.get(
     "PADDLE_TPU_DATA",
     os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"),
 )
+
+# module_name -> "real" | "cache" | "synthetic"; filled by loaders as they
+# decide which source served the samples
+DATA_MODE: dict = {}
+
+
+def data_mode(name: str) -> str:
+    """Which source the last reader for `name` used ('real'/'cache'/
+    'synthetic'; 'unused' if no reader ran yet)."""
+    return DATA_MODE.get(name, "unused")
 
 
 def cache_path(name: str, fname: str) -> str:
@@ -26,6 +47,71 @@ def has_cached(name: str, fname: str) -> bool:
 def load_cached(name: str, fname: str):
     with open(cache_path(name, fname), "rb") as f:
         return pickle.load(f)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None,
+             save_name: str | None = None, retries: int = 3) -> str:
+    """Fetch `url` into DATA_HOME/<module_name>/ with md5 verification
+    (reference v2/dataset/common.py:61 download()).  Returns the local path;
+    raises on unreachable URL or persistent checksum mismatch."""
+    import urllib.request
+
+    fname = save_name or url.split("/")[-1]
+    path = cache_path(module_name, fname)
+    if os.path.exists(path) and (md5sum is None or md5file(path) == md5sum):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    last_err: Exception | None = None
+    for attempt in range(retries):
+        tmp = path + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if md5sum is not None and md5file(tmp) != md5sum:
+                last_err = IOError(
+                    f"md5 mismatch for {url} (attempt {attempt + 1}): "
+                    f"expected {md5sum}, got {md5file(tmp)}")
+                os.remove(tmp)
+                continue
+            os.replace(tmp, path)
+            return path
+        except (OSError, ValueError) as e:
+            last_err = e
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    raise IOError(f"download of {url} failed after {retries} attempts: "
+                  f"{last_err}")
+
+
+def fetch(url: str, module_name: str, md5sum: str | None,
+          save_name: str | None = None) -> str | None:
+    """`download` that degrades to None when the network is unreachable —
+    the zero-egress path; loaders fall back to synthetic data.  A checksum
+    mismatch on a *successful* fetch still raises (corrupt data must not be
+    silently replaced by synthetic)."""
+    fname = save_name or url.split("/")[-1]
+    path = cache_path(module_name, fname)
+    if os.path.exists(path) and (md5sum is None or md5file(path) == md5sum):
+        return path
+    if os.environ.get("PADDLE_TPU_OFFLINE"):
+        return None
+    try:
+        return download(url, module_name, md5sum, save_name, retries=1)
+    except IOError as e:
+        if "md5 mismatch" in str(e):
+            raise
+        print(f"[paddle_tpu.dataset] {module_name}: real data unreachable "
+              f"({url}); falling back to synthetic surrogate", file=sys.stderr)
+        return None
 
 
 def cluster_files_reader(files_pattern, trainer_count, trainer_id,

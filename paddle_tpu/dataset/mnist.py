@@ -1,12 +1,60 @@
 """MNIST (reference v2/dataset/mnist.py): 28x28 grayscale digits.
 
-Real data if cached (idx files or mnist.pkl), else class-template synthetic."""
+Source priority per reader: (1) the real idx-format files (downloaded and
+md5-verified like reference mnist.py:37, or pre-placed in the cache dir),
+(2) a legacy `*.pkl` cache, (3) a deterministic class-template synthetic
+surrogate.  `common.data_mode('mnist')` reports which one served."""
 
 from __future__ import annotations
 
+import gzip
+import struct
+
 import numpy as np
 
-from .common import has_cached, load_cached, synthetic_rng
+from .common import DATA_MODE, fetch, has_cached, load_cached, synthetic_rng
+
+URL_PREFIX = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+# filenames + md5s as in reference mnist.py:21-33 (same idx files; the GCS
+# mirror serves the original yann.lecun.com content)
+TRAIN_IMAGE = ("train-images-idx3-ubyte.gz", "f68b3c2dcbeaaa9fbdd348bbdeb94873")
+TRAIN_LABEL = ("train-labels-idx1-ubyte.gz", "d53e105ee54ea40749a09fcbcd1e9432")
+TEST_IMAGE = ("t10k-images-idx3-ubyte.gz", "9fb629c4189551a2d022fa330f9573f3")
+TEST_LABEL = ("t10k-labels-idx1-ubyte.gz", "ec29112dd5afa0611ce80d1b7f02629c")
+
+
+def parse_idx_images(path: str) -> np.ndarray:
+    """idx3-ubyte (optionally gzipped): big-endian magic 2051, n, rows, cols,
+    then raw pixels.  Returns float32 [n, rows*cols] scaled to [0, 1]."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad idx3 magic {magic}")
+        buf = f.read(n * rows * cols)
+    imgs = np.frombuffer(buf, dtype=np.uint8).reshape(n, rows * cols)
+    return imgs.astype(np.float32) / 255.0
+
+
+def parse_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad idx1 magic {magic}")
+        buf = f.read(n)
+    return np.frombuffer(buf, dtype=np.uint8).astype(np.int64)
+
+
+def _real(image_spec, label_spec):
+    """Both idx files present (or fetchable) -> (imgs, labels); else None."""
+    paths = []
+    for fname, md5 in (image_spec, label_spec):
+        p = fetch(URL_PREFIX + fname, "mnist", md5)
+        if p is None:
+            return None
+        paths.append(p)
+    return parse_idx_images(paths[0]), parse_idx_labels(paths[1])
 
 
 def _synthetic(n, seed):
@@ -18,11 +66,17 @@ def _synthetic(n, seed):
     return imgs.astype(np.float32), labels.astype(np.int64)
 
 
-def _reader(n, seed, fname):
+def _reader(n, seed, image_spec, label_spec, pkl_name):
     def reader():
-        if has_cached("mnist", fname):
-            imgs, labels = load_cached("mnist", fname)
+        real = _real(image_spec, label_spec)
+        if real is not None:
+            DATA_MODE["mnist"] = "real"
+            imgs, labels = real
+        elif has_cached("mnist", pkl_name):
+            DATA_MODE["mnist"] = "cache"
+            imgs, labels = load_cached("mnist", pkl_name)
         else:
+            DATA_MODE["mnist"] = "synthetic"
             imgs, labels = _synthetic(n, seed)
         for x, y in zip(imgs, labels):
             yield x, int(y)
@@ -31,8 +85,8 @@ def _reader(n, seed, fname):
 
 
 def train(n=8192):
-    return _reader(n, 0, "train.pkl")
+    return _reader(n, 0, TRAIN_IMAGE, TRAIN_LABEL, "train.pkl")
 
 
 def test(n=1024):
-    return _reader(n, 1, "test.pkl")
+    return _reader(n, 1, TEST_IMAGE, TEST_LABEL, "test.pkl")

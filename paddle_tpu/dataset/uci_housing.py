@@ -1,15 +1,45 @@
-"""UCI housing (reference v2/dataset/uci_housing.py): 13 features -> price."""
+"""UCI housing (reference v2/dataset/uci_housing.py): 13 features -> price.
+
+Real data is the whitespace-separated housing.data table (reference
+uci_housing.py:28 URL/md5), feature-normalised the reference way
+((x - mean) / (max - min) per column) and split 80/20 train/test.
+Fallbacks: legacy pkl cache, then a synthetic linear-model surrogate."""
 
 from __future__ import annotations
 
 import numpy as np
 
-from .common import has_cached, load_cached, synthetic_rng
+from .common import DATA_MODE, fetch, has_cached, load_cached, synthetic_rng
+
+URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+       "housing/housing.data")
+MD5 = "d4accdce7a25600298819f8e28e8d593"
 
 
-def _data(n, seed):
+def parse_housing(path: str):
+    """-> (x [n,13] float32 normalised, y [n,1] float32)."""
+    table = np.loadtxt(path, dtype=np.float64)
+    if table.ndim != 2 or table.shape[1] != 14:
+        raise ValueError(f"{path}: expected 14 columns, got {table.shape}")
+    feats = table[:, :13]
+    spread = feats.max(axis=0) - feats.min(axis=0)
+    spread[spread == 0] = 1.0
+    x = ((feats - feats.mean(axis=0)) / spread).astype(np.float32)
+    y = table[:, 13:14].astype(np.float32)
+    return x, y
+
+
+def _data(n, seed, split):
+    path = fetch(URL, "uci_housing", MD5)
+    if path is not None:
+        DATA_MODE["uci_housing"] = "real"
+        x, y = parse_housing(path)
+        cut = int(len(x) * 0.8)  # reference 80/20 split point
+        return (x[:cut], y[:cut]) if split == "train" else (x[cut:], y[cut:])
     if has_cached("uci_housing", "housing.pkl"):
+        DATA_MODE["uci_housing"] = "cache"
         return load_cached("uci_housing", "housing.pkl")
+    DATA_MODE["uci_housing"] = "synthetic"
     rng = synthetic_rng("uci_housing", seed)
     w = rng.uniform(-1, 1, (13, 1))
     x = rng.uniform(-1, 1, (n, 13)).astype(np.float32)
@@ -19,7 +49,7 @@ def _data(n, seed):
 
 def train(n=404):
     def reader():
-        x, y = _data(n, 0)
+        x, y = _data(n, 0, "train")
         for xi, yi in zip(x, y):
             yield xi, yi
 
@@ -28,7 +58,7 @@ def train(n=404):
 
 def test(n=102):
     def reader():
-        x, y = _data(n, 1)
+        x, y = _data(n, 1, "test")
         for xi, yi in zip(x, y):
             yield xi, yi
 

@@ -7,6 +7,10 @@ backend initializes so --xla_force_host_platform_device_count takes effect."""
 
 import os
 
+# never attempt dataset downloads from tests (zero-egress environment);
+# pre-populated caches and file:// URLs still work
+os.environ.setdefault("PADDLE_TPU_OFFLINE", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""MFU analysis for the ResNet-50 train step (VERDICT r1 Weak #1).
+
+Measures the compiled step's wall time and asks XLA itself for the FLOP
+count (compiled.cost_analysis), so the MFU figure is the compiler's own
+accounting rather than a hand-derived per-image constant.
+
+Usage: python tools/profile_resnet.py [--trace DIR]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--trace", default=None,
+                    help="jax.profiler trace output dir")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.framework.core import np_dtype
+    from paddle_tpu.models import resnet
+
+    avg_cost, acc = resnet.build_train_program(
+        batch_size=args.bs, depth=args.depth, dtype=args.dtype)
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    feed = {
+        "image": jax.device_put(
+            jnp.asarray(rng.rand(args.bs, 3, 224, 224).astype(np.float32),
+                        dtype=np_dtype(args.dtype)), dev),
+        "label": jax.device_put(
+            jnp.asarray(rng.randint(0, 1000, (args.bs, 1)).astype(np.int64)),
+            dev),
+    }
+
+    for _ in range(3):
+        (loss,) = exe.run(feed=feed, fetch_list=[avg_cost])
+
+    # pick the train-step entry (the other cache entry is the startup program)
+    compiled = next(c for _, c in exe._cache.values()
+                    if avg_cost.name in c.fetch_names)
+    cost = {}
+    try:
+        # jax.jit caches its executable per input signature; lowering again
+        # with the same shapes hits the C++ fast path's records
+        lowered = None
+        for ex in compiled.fn._cache_size and []:  # pragma: no cover
+            pass
+        # AOT-lower a fresh copy for cost analysis (cheap: cache-hit on trace)
+        state_w = {n: fluid.global_scope().find(n) for n in compiled.rw_state}
+        state_r = {n: fluid.global_scope().find(n)
+                   for n in compiled.external_reads}
+        rngk = jax.random.PRNGKey(0)
+        lowered = compiled.fn.lower(state_w, state_r, feed, rngk)
+        cost = lowered.compile().cost_analysis() or {}
+        if isinstance(cost, list):
+            cost = cost[0]
+    except Exception as e:  # cost analysis is best-effort on tunneled PJRT
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        (loss,) = exe.run(feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.iters
+    if args.trace:
+        jax.profiler.stop_trace()
+
+    img_s = args.bs / dt
+    flops = float(cost.get("flops", 0.0))
+    print(f"step time        : {dt*1e3:.2f} ms")
+    print(f"throughput       : {img_s:.1f} img/s")
+    if flops:
+        print(f"XLA flops/step   : {flops/1e9:.2f} GFLOP "
+              f"({flops/args.bs/1e9:.2f} GFLOP/img)")
+        print(f"achieved         : {flops/dt/1e12:.1f} TFLOP/s")
+        print(f"MFU (v5e bf16)   : {100*flops/dt/V5E_PEAK_BF16:.1f}%")
+    for k in sorted(cost):
+        if "bytes" in k or "time" in k:
+            print(f"  {k}: {cost[k]:.3e}")
+
+
+if __name__ == "__main__":
+    main()
