@@ -112,10 +112,12 @@ def conv2d(
     bias_attr=None,
     act=None,
     name=None,
+    data_format="NCHW",
 ):
     helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
-    num_channels = input.shape[1]
+    nhwc = data_format == "NHWC"
+    num_channels = input.shape[3] if nhwc else input.shape[1]
     fs = filter_size if isinstance(filter_size, (list, tuple)) else (
         filter_size, filter_size)
     stride = stride if isinstance(stride, (list, tuple)) else (stride, stride)
@@ -137,16 +139,19 @@ def conv2d(
         ke = d * (k - 1) + 1
         return (i + 2 * p - ke) // s + 1
 
-    oh = _od(input.shape[2], fs[0], stride[0], padding[0], dilation[0])
-    ow = _od(input.shape[3], fs[1], stride[1], padding[1], dilation[1])
-    out = helper.create_tmp_variable(
-        input.dtype, shape=(input.shape[0], num_filters, oh, ow))
+    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
+    oh = _od(input.shape[h_ax], fs[0], stride[0], padding[0], dilation[0])
+    ow = _od(input.shape[w_ax], fs[1], stride[1], padding[1], dilation[1])
+    oshape = ((input.shape[0], oh, ow, num_filters) if nhwc
+              else (input.shape[0], num_filters, oh, ow))
+    out = helper.create_tmp_variable(input.dtype, shape=oshape)
     helper.append_op(
         "conv2d",
         inputs={"Input": [input.name], "Filter": [w.name]},
         outputs={"Output": [out.name]},
         attrs={"strides": list(stride), "paddings": list(padding),
-               "dilations": list(dilation), "groups": groups},
+               "dilations": list(dilation), "groups": groups,
+               "data_format": data_format},
     )
     if bias_attr is not False:
         b = helper.create_parameter(
@@ -157,15 +162,17 @@ def conv2d(
             "elementwise_add",
             inputs={"X": [out.name], "Y": [b.name]},
             outputs={"Out": [tmp.name]},
-            attrs={"axis": 1},
+            attrs={"axis": 3 if nhwc else 1},
         )
         out = tmp
     return helper.append_activation(out)
 
 
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=None,
-            pool_padding=0, global_pooling=False, ceil_mode=False, name=None):
+            pool_padding=0, global_pooling=False, ceil_mode=False, name=None,
+            data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
+    nhwc = data_format == "NHWC"
     ps = pool_size if isinstance(pool_size, (list, tuple)) else (
         pool_size, pool_size)
     st = pool_stride or ps
@@ -178,20 +185,24 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=None,
             return -1
         return (i + 2 * p - k) // s + 1
 
+    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
     if global_pooling:
         oh = ow = 1
     else:
-        oh = _od(input.shape[2], ps[0], st[0], pd[0])
-        ow = _od(input.shape[3], ps[1], st[1], pd[1])
-    out = helper.create_tmp_variable(
-        input.dtype, shape=(input.shape[0], input.shape[1], oh, ow))
+        oh = _od(input.shape[h_ax], ps[0], st[0], pd[0])
+        ow = _od(input.shape[w_ax], ps[1], st[1], pd[1])
+    ch = input.shape[3] if nhwc else input.shape[1]
+    oshape = ((input.shape[0], oh, ow, ch) if nhwc
+              else (input.shape[0], ch, oh, ow))
+    out = helper.create_tmp_variable(input.dtype, shape=oshape)
     helper.append_op(
         "pool2d",
         inputs={"X": [input.name]},
         outputs={"Out": [out.name]},
         attrs={"pooling_type": pool_type, "ksize": list(ps),
                "strides": list(st), "paddings": list(pd),
-               "global_pooling": global_pooling},
+               "global_pooling": global_pooling,
+               "data_format": data_format},
     )
     return out
 
@@ -199,7 +210,7 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=None,
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
     helper = LayerHelper("batch_norm", act=act, name=name)
-    c = input.shape[1]
+    c = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
     dtype = input.dtype
     scale = helper.create_parameter(
         attr=param_attr if isinstance(param_attr, dict) else {},
@@ -225,7 +236,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                  "VarianceOut": [variance.name],
                  "SavedMean": [saved_mean.name],
                  "SavedVariance": [saved_var.name]},
-        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout},
     )
     return helper.append_activation(out)
 

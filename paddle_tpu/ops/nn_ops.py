@@ -19,10 +19,15 @@ def _pair(v):
 
 @register_op("conv2d")
 def conv2d(ctx, ins, attrs):
+    """data_format NCHW (reference default) or NHWC — on TPU the NHWC
+    activation layout avoids the relayout XLA otherwise inserts around each
+    convolution (filters stay OIHW in both: their relayout is one-off and
+    folded into the weight)."""
     import jax
 
-    x = ins["Input"][0]  # NCHW
+    x = ins["Input"][0]
     w = ins["Filter"][0]  # OIHW
+    fmt = str(attrs.get("data_format", "NCHW"))
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
@@ -33,7 +38,7 @@ def conv2d(ctx, ins, attrs):
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=groups,
         preferred_element_type=None,
     )
@@ -43,7 +48,8 @@ def conv2d(ctx, ins, attrs):
 @register_op("depthwise_conv2d")
 def depthwise_conv2d(ctx, ins, attrs):
     attrs = dict(attrs)
-    attrs["groups"] = ins["Input"][0].shape[1]
+    ch_axis = 3 if str(attrs.get("data_format", "NCHW")) == "NHWC" else 1
+    attrs["groups"] = ins["Input"][0].shape[ch_axis]
     return conv2d(ctx, ins, attrs)
 
 
@@ -126,23 +132,30 @@ def conv3d_transpose(ctx, ins, attrs):
 
 
 def _pool_nd(x, attrs, ndim):
-    """Shared max/avg window pooling over the trailing `ndim` spatial dims
-    (pool_op.cc pool2d/pool3d common path)."""
+    """Shared max/avg window pooling over the `ndim` spatial dims
+    (pool_op.cc pool2d/pool3d common path).  data_format NCHW (spatial dims
+    trailing) or NHWC (channels trailing)."""
     import jax
     import jax.numpy as jnp
 
     tup = _pair if ndim == 2 else _triple
+    nhwc = str(attrs.get("data_format", "NCHW")) in ("NHWC", "NDHWC")
     ptype = attrs.get("pooling_type", "max")
     ksize = tup(attrs.get("ksize", [2] * ndim))
     strides = tup(attrs.get("strides", ksize))
     pads = tup(attrs.get("paddings", [0] * ndim))
     if attrs.get("global_pooling", False):
-        ksize = list(x.shape[2:])
+        ksize = list(x.shape[1:-1] if nhwc else x.shape[2:])
         strides = ksize
         pads = [0] * ndim
-    window = (1, 1) + tuple(ksize)
-    stridesn = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if nhwc:
+        window = (1,) + tuple(ksize) + (1,)
+        stridesn = (1,) + tuple(strides) + (1,)
+        padding = ((0, 0),) + tuple((p, p) for p in pads) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(ksize)
+        stridesn = (1, 1) + tuple(strides)
+        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
     if ptype == "max":
         return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
                                      stridesn, padding)
@@ -185,9 +198,11 @@ def batch_norm(ctx, ins, attrs):
     momentum = float(attrs.get("momentum", 0.9))
     is_test = bool(attrs.get("is_test", False)) or ctx.is_test
 
-    axes = tuple(i for i in range(x.ndim) if i != 1)
+    fmt = str(attrs.get("data_layout", attrs.get("data_format", "NCHW")))
+    ch = x.ndim - 1 if fmt in ("NHWC", "NDHWC", "NLC") else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch)
     shape = [1] * x.ndim
-    shape[1] = x.shape[1]
+    shape[ch] = x.shape[ch]
 
     if is_test:
         use_mean, use_var = mean, var

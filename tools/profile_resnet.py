@@ -28,6 +28,11 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--trace", default=None,
                     help="jax.profiler trace output dir")
+    ap.add_argument("--no-cost", action="store_true",
+                    help="skip AOT cost analysis (isolates its device-side "
+                         "footprint from the timing)")
+    ap.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"],
+                    help="activation layout (bench.py headline default NHWC)")
     args = ap.parse_args()
 
     import jax
@@ -38,16 +43,19 @@ def main():
     from paddle_tpu.models import resnet
 
     avg_cost, acc = resnet.build_train_program(
-        batch_size=args.bs, depth=args.depth, dtype=args.dtype)
+        batch_size=args.bs, depth=args.depth, dtype=args.dtype,
+        layout=args.layout)
     place = fluid.default_place()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
 
     rng = np.random.RandomState(0)
     dev = place.jax_device()
+    img_shape = ((args.bs, 224, 224, 3) if args.layout == "NHWC"
+                 else (args.bs, 3, 224, 224))
     feed = {
         "image": jax.device_put(
-            jnp.asarray(rng.rand(args.bs, 3, 224, 224).astype(np.float32),
+            jnp.asarray(rng.rand(*img_shape).astype(np.float32),
                         dtype=np_dtype(args.dtype)), dev),
         "label": jax.device_put(
             jnp.asarray(rng.randint(0, 1000, (args.bs, 1)).astype(np.int64)),
@@ -62,6 +70,8 @@ def main():
                     if avg_cost.name in c.fetch_names)
     cost = {}
     try:
+        if args.no_cost:
+            raise RuntimeError("--no-cost")
         # jax.jit caches its executable per input signature; lowering again
         # with the same shapes hits the C++ fast path's records
         lowered = None
