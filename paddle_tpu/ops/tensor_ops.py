@@ -374,3 +374,10 @@ def load_op(ctx, ins, attrs):
     if attrs.get("dtype"):
         arr = arr.astype(np_dtype(attrs["dtype"]), copy=False)
     return {"Out": [jnp.asarray(arr)]}
+
+
+@register_op("pipeline_stage", grad=None)
+def pipeline_stage(ctx, ins, attrs):
+    """Stage-boundary marker for parallel.ProgramPipeline; pure no-op under
+    the single-device Executor so the same program runs unchanged there."""
+    return {}
