@@ -62,7 +62,10 @@ def sequence_softmax(ctx, ins, attrs):
     x = ins["X"][0]  # [B, T]
     lengths = ins["Length"][0]
     m = _mask(lengths, x.shape[1], jnp.float32)
-    logits = jnp.where(m > 0, x.astype(jnp.float32), -1e9)
+    # promote, never downcast: a float64 trace (gradient checking) must not
+    # lose precision through a hard-coded float32 softmax
+    ft = jnp.promote_types(x.dtype, jnp.float32)
+    logits = jnp.where(m > 0, x.astype(ft), ft.type(-1e9))
     return {"Out": [jax.nn.softmax(logits, axis=-1).astype(x.dtype) * m.astype(x.dtype)]}
 
 
