@@ -33,3 +33,9 @@ from .optimizers import (AdaDeltaOptimizer, AdaGradOptimizer,  # noqa: F401
                          RMSPropOptimizer, optimizer_from_settings, settings)
 from .poolings import (AvgPooling, FirstPooling, LastPooling,  # noqa: F401
                        MaxPooling, SqrtAvgPooling, SumPooling)
+from .data_provider import (CacheType, dense_vector,  # noqa: F401
+                            dense_vector_sequence, define_py_data_sources2,
+                            integer_value, integer_value_sequence, provider,
+                            sparse_binary_vector, sparse_float_vector,
+                            sparse_value)
+from .trainer import V1Trainer  # noqa: F401

@@ -84,14 +84,55 @@ def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
     global _settings
     _settings = dict(batch_size=batch_size, learning_rate=learning_rate,
                      learning_method=learning_method,
+                     regularization=regularization,
                      gradient_clipping_threshold=gradient_clipping_threshold,
                      **kw)
     return _settings
 
 
+def settings_dict():
+    """The last settings() call's recorded config (empty if none)."""
+    return dict(_settings)
+
+
+class L2Regularization:
+    """v1 regularization declaration (reference default_decay_rate style)."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def to_fluid(self):
+        from ..regularizer import L2DecayRegularizer
+
+        return L2DecayRegularizer(regularization_coeff=self.rate)
+
+
+class L1Regularization:
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def to_fluid(self):
+        from ..regularizer import L1DecayRegularizer
+
+        return L1DecayRegularizer(regularization_coeff=self.rate)
+
+
 def optimizer_from_settings():
+    """Build the fluid optimizer from settings(), carrying regularization
+    and gradient clipping through (not just lr/method)."""
     lm = _settings.get("learning_method")
     lr = _settings.get("learning_rate", 1e-3)
-    if lm is None:
-        return fluid_opt.SGD(learning_rate=lr)
-    return lm.to_fluid(lr)
+    opt = fluid_opt.SGD(learning_rate=lr) if lm is None else lm.to_fluid(lr)
+    reg = _settings.get("regularization")
+    if reg is not None:
+        if hasattr(reg, "to_fluid"):
+            reg = reg.to_fluid()
+        elif isinstance(reg, (int, float)):
+            from ..regularizer import L2DecayRegularizer
+
+            reg = L2DecayRegularizer(regularization_coeff=float(reg))
+        opt.regularization = reg
+    clip = _settings.get("gradient_clipping_threshold")
+    if clip:
+        opt.global_clip_norm = float(clip)
+    return opt

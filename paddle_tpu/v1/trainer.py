@@ -1,0 +1,85 @@
+"""v1 trainer loop: drive a v1 config + @provider data sources.
+
+The reference's `paddle train --config=conf.py` flow (trainer/Trainer.cpp
+over TrainerConfig): the config declares data sources
+(define_py_data_sources2), topology (v1 layers ending in a cost), and
+settings(); the trainer then runs `num_passes` over the provider.  Here the
+config uses the same v1 functions, the cost's Program is compiled whole into
+XLA, and this loop pulls batched feeds from the registered DataProvider."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .data_provider import get_data_source
+from .optimizers import optimizer_from_settings, settings_dict
+
+
+class V1Trainer:
+    """Train a v1-config cost with the registered @provider data source.
+
+    cost: the v1 cost LayerOutput (or fluid Variable).
+    batch_size: overrides settings(batch_size=...) when given.
+    feed_order: data_layer names per slot, required only when the provider
+    declares list-style input_types (dict input_types feed by key)."""
+
+    def __init__(self, cost, batch_size: Optional[int] = None, place=None,
+                 feed_order=None):
+        import paddle_tpu as fluid
+
+        self.cost_var = getattr(cost, "var", cost)
+        self.feed_order = list(feed_order) if feed_order else None
+        conf = settings_dict()
+        self.batch_size = int(batch_size or conf.get("batch_size") or 32)
+        # eval-mode clone BEFORE optimizer ops exist: test() must not touch
+        # parameters
+        self.test_program = fluid.default_main_program().clone(for_test=True)
+        optimizer_from_settings().minimize(self.cost_var)
+        self.place = place if place is not None else fluid.CPUPlace()
+        self.exe = fluid.Executor(self.place)
+        self.exe.run(fluid.default_startup_program())
+        self._fluid = fluid
+
+    def train(self, num_passes: int = 1,
+              event_handler: Optional[Callable] = None):
+        """Run `num_passes` over the registered train source; returns the
+        per-pass mean losses.  event_handler(pass_id, batch_id, loss) is
+        called per batch (v2-style observability on the v1 loop)."""
+        prov, files = get_data_source("train")
+        if prov is None:
+            raise RuntimeError(
+                "no train data source — call define_py_data_sources2 in "
+                "the config first")
+        pass_losses = []
+        for pass_id in range(num_passes):
+            losses = []
+            for batch_id, feed in enumerate(
+                    prov.batches(files, self.batch_size, seed=pass_id,
+                                 data_layer_names=self.feed_order)):
+                (loss,) = self.exe.run(feed=feed,
+                                       fetch_list=[self.cost_var])
+                val = float(np.asarray(loss).reshape(-1)[0])
+                losses.append(val)
+                if event_handler is not None:
+                    event_handler(pass_id, batch_id, val)
+            pass_losses.append(float(np.mean(losses)) if losses
+                               else float("nan"))
+        return pass_losses
+
+    def test(self):
+        """Mean cost over the registered test source: one pass of the
+        eval-mode program (cloned before minimize — no parameter updates,
+        BN/dropout in inference mode)."""
+        prov, files = get_data_source("test")
+        if prov is None:
+            raise RuntimeError("no test data source registered")
+        losses = [
+            float(np.asarray(
+                self.exe.run(self.test_program, feed=feed,
+                             fetch_list=[self.cost_var])[0]).reshape(-1)[0])
+            for feed in prov.batches(files, self.batch_size, seed=0,
+                                     data_layer_names=self.feed_order)
+        ]
+        return float(np.mean(losses)) if losses else float("nan")

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# CI entry (VERDICT r1 Missing #7): rebuild natives from source, then run the
+# full suite on the virtual 8-device CPU mesh, then the multichip dryrun.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+./build_native.sh
+
+python -m pytest tests/ -q "$@"
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
