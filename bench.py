@@ -133,6 +133,54 @@ def bench_resnet_infer(warmup, iters):
     }
 
 
+def bench_cnn_train(model_name, warmup, iters):
+    """AlexNet / GoogleNet / VGG-19 training throughput (reference
+    benchmark/paddle/image anchors: AlexNet 498.94 img/s bs128 MKL-DNN
+    IntelOptimizedPaddle.md:65; GoogleNet 264.83 img/s bs128 :55; VGG-19
+    29.83 img/s bs128 :35).  Opt-in via BENCH_MODEL=alexnet|googlenet|vgg."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework.core import np_dtype
+    from paddle_tpu.models import image_models, vgg
+
+    base = {"alexnet": 498.94, "googlenet": 264.83, "vgg": 29.83}[model_name]
+    bs = int(os.environ.get("BENCH_BS", "128"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    img = layers.data(name="image", shape=[3, 224, 224], dtype=dtype)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    if model_name == "alexnet":
+        logits = image_models.alexnet(img, class_dim=1000)
+    elif model_name == "googlenet":
+        logits = image_models.googlenet(img, class_dim=1000)
+    else:
+        logits = vgg.vgg19(img, class_dim=1000)  # the VGG-19 anchor's model
+    logits32 = layers.cast(logits, "float32") if dtype != "float32" else logits
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits32, label))
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _stage(place, {
+        "image": jnp.asarray(rng.rand(bs, 3, 224, 224).astype(np.float32),
+                             dtype=np_dtype(dtype)),
+        "label": jnp.asarray(rng.randint(0, 1000, (bs, 1)).astype(np.int64)),
+    })
+    dt = _timed_loop(exe, feed, loss, warmup, iters)
+    img_s = bs / dt
+    name = "vgg19" if model_name == "vgg" else model_name
+    return {
+        "metric": f"{name}_train_img_per_s_{dtype}_bs{bs}",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / base, 2),
+    }
+
+
 def bench_lstm_train(warmup, iters):
     """Reference RNN baseline shape (benchmark/README.md:119): stacked
     2xLSTM+fc text classification, bs64 h512 seqlen100 -> 184 ms/batch on
@@ -196,6 +244,9 @@ def main():
         "lstm": bench_lstm_train,
         "infer": bench_resnet_infer,
     }
+    if model in ("alexnet", "googlenet", "vgg"):
+        print(json.dumps(bench_cnn_train(model, warmup, iters)))
+        return
     if model != "all":
         print(json.dumps(runners[model](warmup, iters)))
         return

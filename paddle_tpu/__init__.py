@@ -63,3 +63,7 @@ def reset():
     switch_startup_program(Program())
     reset_global_scope()
     unique_name.reset()
+    # v1 config state tied to the discarded Program
+    from .v1 import layers as _v1_layers
+
+    _v1_layers._declared_outputs.clear()

@@ -46,8 +46,32 @@ def cmd_version(args) -> int:
 def cmd_train(args) -> int:
     import runpy
 
-    sys.argv = [args.script] + args.script_args
-    runpy.run_path(args.script, run_name="__main__")
+    if args.script:
+        sys.argv = [args.script] + args.script_args
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    # --config: the reference trainer flow (submit_local.sh `paddle train
+    # --config=conf.py [--job=time]`): exec a v1 config that declares data
+    # sources, topology ending in outputs(cost), and settings(); then train
+    runpy.run_path(args.config, run_name="__config__")
+    from .v1 import V1Trainer
+    from .v1.layers import declared_outputs
+
+    outs = declared_outputs()
+    if not outs:
+        print("config did not call outputs(cost)", file=sys.stderr)
+        return 1
+    trainer = V1Trainer(outs[0], batch_size=args.batch_size or None)
+    if args.job == "time":
+        ms, last_loss = trainer.time(args.time_batches)
+        print(json.dumps({"job": "time", "ms_per_batch": round(ms, 3),
+                          "batch_size": trainer.batch_size,
+                          "last_loss": last_loss}))
+        return 0
+    losses = trainer.train(num_passes=args.num_passes)
+    for i, l in enumerate(losses):
+        print(f"Pass {i}: cost={l:.6f}")
     return 0
 
 
@@ -117,7 +141,14 @@ def main(argv=None) -> int:
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     p = sub.add_parser("train")
-    p.add_argument("--script", required=True)
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--script", help="run a python training script")
+    g.add_argument("--config",
+                   help="v1 config (data sources + topology + settings)")
+    p.add_argument("--job", choices=["train", "time"], default="train")
+    p.add_argument("--num-passes", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--time-batches", type=int, default=5)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_train)
 

@@ -51,10 +51,10 @@ class _Compiled:
         self.rw_state = rw_state  # read-then-written: must pre-exist, donated
         self.written_state = written_state  # all names persisted back to scope
         self.fetch_names = fetch_names
-        # (path, overwrite) per `save` op in the block, written post-step.
-        # NOTE: kept by reference — the list is filled as a trace-time side
-        # effect on the first fn() call, after this object is constructed
-        self.save_specs = save_specs
+        # (path, overwrite) per `save` op, derived statically from the block
+        # descs at compile time (order = op order = the order emitters append
+        # their traced values); the trace asserts it produced exactly these
+        self.save_specs = tuple(save_specs)
 
 
 def _fetch_name(f) -> str:
@@ -298,9 +298,11 @@ class Executor:
             for op in block.ops
         )
 
-        # populated as a trace-time side effect of the first run (covers
-        # `save` ops in nested blocks too)
-        save_specs: List[tuple] = []
+        # static save manifest from the descs (save ops inside control-flow
+        # sub-blocks are rejected at emit time, so the top block is complete)
+        save_specs = [(str(op.attrs["file_path"]),
+                       bool(op.attrs.get("overwrite", True)))
+                      for op in block.ops if op.type == "save"]
 
         def step_fn(state_w, state_r, feeds, rng_key):
             env = {}
@@ -321,8 +323,12 @@ class Executor:
             _lower_ops(block.ops, env, ctx)
             fetches = {n: env[n] for n in fetch_names}
             # `save` ops: their traced values leave the program as reserved
-            # fetches; Executor.run writes the files after the step
-            save_specs[:] = [(p, o) for p, o, _ in ctx.host_saves]
+            # fetches; Executor.run writes the files after the step.  Any
+            # retrace must reproduce the static manifest exactly
+            if [(p, o) for p, o, _ in ctx.host_saves] != save_specs:
+                raise RuntimeError(
+                    f"save ops traced {[(p, o) for p, o, _ in ctx.host_saves]}"
+                    f" but the block declares {save_specs}")
             for i, (_, _, val) in enumerate(ctx.host_saves):
                 fetches[f"{_SAVE_PREFIX}{i}"] = val
             new_state = {n: env[n] for n in written_state if n in env}

@@ -759,10 +759,20 @@ def sum_cost(input, name=None):
 
 # --- graph finalize ----------------------------------------------------------
 
+_declared_outputs: list = []
+
+
 def outputs(*layers):
     """Mark network outputs (config_parser outputs()).  Returns the fluid
-    Variables so callers can fetch them."""
+    Variables so callers can fetch them; also records them so the `paddle
+    train --config` driver can find the config's cost after exec."""
+    _declared_outputs[:] = list(layers)
     return [_var(l) for l in layers]
+
+
+def declared_outputs():
+    """The LayerOutputs recorded by the last outputs() call."""
+    return list(_declared_outputs)
 
 
 def parse_network(*outputs_) -> Program:

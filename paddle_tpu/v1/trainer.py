@@ -68,6 +68,30 @@ class V1Trainer:
                                else float("nan"))
         return pass_losses
 
+    def time(self, num_batches: int = 5):
+        """Reference `--job=time`: compile on the first batch, then time
+        `num_batches` steps.  Returns (ms_per_batch, last_loss)."""
+        import time as _time
+
+        prov, files = get_data_source("train")
+        if prov is None:
+            raise RuntimeError(
+                "no train data source — call define_py_data_sources2 in "
+                "the config first")
+        it = prov.batches(files, self.batch_size, seed=0,
+                          data_layer_names=self.feed_order)
+        feeds = [f for _, f in zip(range(max(1, num_batches) + 1), it)]
+        if not feeds:
+            raise RuntimeError("train data source yielded no batches")
+        (loss,) = self.exe.run(feed=feeds[0],
+                               fetch_list=[self.cost_var])  # compile
+        timed = feeds[1:] or feeds  # tiny dataset: re-time the only batch
+        t0 = _time.perf_counter()
+        for f in timed:
+            (loss,) = self.exe.run(feed=f, fetch_list=[self.cost_var])
+        dt = (_time.perf_counter() - t0) / len(timed)
+        return dt * 1e3, float(np.asarray(loss).reshape(-1)[0])
+
     def test(self):
         """Mean cost over the registered test source: one pass of the
         eval-mode program (cloned before minimize — no parameter updates,

@@ -55,3 +55,66 @@ def test_train_runs_script(tmp_path, capsys):
     script.write_text("print('hello-from-train')\n")
     assert cli.main(["train", "--script", str(script)]) == 0
     assert "hello-from-train" in capsys.readouterr().out
+
+
+def test_train_config_flow(tmp_path, capsys):
+    """`paddle train --config conf.py` (reference submit_local.sh flow):
+    the config declares a provider, topology with outputs(cost), and
+    settings(); both --job=train and --job=time drive it."""
+    import textwrap
+
+    from paddle_tpu.v1.data_provider import reset_data_sources
+
+    rng = np.random.RandomState(0)
+    data = tmp_path / "data.txt"
+    with open(data, "w") as f:
+        for _ in range(48):
+            lab = rng.randint(0, 2)
+            x = rng.rand(4) * 0.3 + lab * 0.5
+            f.write(" ".join(f"{v:.4f}" for v in x) + f" {lab}\n")
+
+    prov = tmp_path / "conf_provider.py"
+    prov.write_text(textwrap.dedent("""
+        from paddle_tpu.v1.data_provider import (provider, dense_vector,
+                                                 integer_value)
+
+        @provider(input_types={"x": dense_vector(4),
+                               "label": integer_value(2)},
+                  should_shuffle=False)
+        def process(settings, file_name):
+            for line in open(file_name):
+                parts = line.split()
+                yield {"x": [float(v) for v in parts[:4]],
+                       "label": int(parts[4])}
+    """))
+    conf = tmp_path / "conf.py"
+    conf.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {str(tmp_path)!r})
+        from paddle_tpu import v1
+
+        v1.define_py_data_sources2({str(data)!r}, None,
+                                   module="conf_provider", obj="process")
+        x = v1.data_layer(name="x", size=4)
+        label = v1.data_layer(name="label", size=2, dtype="int64")
+        pred = v1.fc_layer(input=x, size=2, act=v1.SoftmaxActivation())
+        cost = v1.classification_cost(input=pred, label=label)
+        v1.settings(batch_size=16, learning_rate=0.3)
+        v1.outputs(cost)
+    """))
+
+    try:
+        assert cli.main(["train", "--config", str(conf),
+                         "--num-passes", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "Pass 0" in out and "Pass 2" in out
+
+        fluid.reset()
+        reset_data_sources()
+        assert cli.main(["train", "--config", str(conf),
+                         "--job", "time", "--time-batches", "2"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(line)
+        assert rec["job"] == "time" and rec["ms_per_batch"] > 0
+    finally:
+        reset_data_sources()

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# MFU experiment matrix on the real TPU chip (VERDICT r1 Weak #1): layout
+# A/B, batch-size sweep, and the compiled-flops MFU readout. One command so
+# the whole sweep runs the moment the tunnel is healthy.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== layout A/B at bs128 =="
+for layout in NHWC NCHW; do
+    BENCH_MODEL=resnet BENCH_LAYOUT=$layout python bench.py 2>/dev/null | tail -1
+done
+
+echo "== batch-size sweep (NHWC) =="
+for bs in 64 128 192 256; do
+    BENCH_MODEL=resnet BENCH_LAYOUT=NHWC BENCH_BS=$bs python bench.py \
+        2>/dev/null | tail -1
+done
+
+echo "== MFU readout (XLA cost_analysis) =="
+for layout in NHWC NCHW; do
+    echo "-- $layout --"
+    python tools/profile_resnet.py --layout $layout 2>/dev/null \
+        | grep -E "step time|throughput|flops|achieved|MFU"
+done
