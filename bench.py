@@ -16,7 +16,9 @@ Baseline anchors (BASELINE.md):
 
 Whole train step (fwd+bwd+momentum update) is one compiled XLA program; conv
 stack runs in bfloat16 on the MXU, loss head + BN stats in float32.
-BENCH_MODEL=resnet|lstm|infer|all selects modes (default all).
+BENCH_MODEL=resnet|lstm|infer|all selects modes (default all); the extra
+opt-in single-model modes alexnet|googlenet|vgg (VGG-19) anchor the other
+BASELINE.md CNN rows and are not part of "all".
 Overrides: BENCH_BS (resnet-train; also lstm when BENCH_MODEL=lstm),
 BENCH_LSTM_BS, BENCH_INFER_BS, BENCH_DTYPE, BENCH_ITERS, BENCH_LAYOUT
 (NHWC default / NCHW).

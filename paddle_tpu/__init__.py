@@ -63,7 +63,8 @@ def reset():
     switch_startup_program(Program())
     reset_global_scope()
     unique_name.reset()
-    # v1 config state tied to the discarded Program
-    from .v1 import layers as _v1_layers
+    # v1 config state tied to the discarded Program (declared outputs AND
+    # registered data sources — stale providers must not feed a new config)
+    from .v1 import reset_v1_config
 
-    _v1_layers._declared_outputs.clear()
+    reset_v1_config()

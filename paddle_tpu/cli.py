@@ -64,10 +64,14 @@ def cmd_train(args) -> int:
         return 1
     trainer = V1Trainer(outs[0], batch_size=args.batch_size or None)
     if args.job == "time":
+        import math
+
         ms, last_loss = trainer.time(args.time_batches)
         print(json.dumps({"job": "time", "ms_per_batch": round(ms, 3),
                           "batch_size": trainer.batch_size,
-                          "last_loss": last_loss}))
+                          # strict JSON: NaN/Inf are not valid tokens
+                          "last_loss": last_loss
+                          if math.isfinite(last_loss) else None}))
         return 0
     losses = trainer.train(num_passes=args.num_passes)
     for i, l in enumerate(losses):

@@ -290,13 +290,16 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 
 def multi_head_attention(queries, keys, values, num_heads, causal=False,
-                         param_attr=None, name=None):
+                         param_attr=None, name=None, sp_mode="ring"):
     """Transformer multi-head attention over [B, T, D] (beyond-reference:
     the 2018 reference's closest construct is v1 simple_attention).  QKV and
     output projections are fc ops (MXU GEMMs); the core runs
-    scaled_dot_product_attention — ring attention when the executor's mesh
-    has an 'sp' axis."""
+    scaled_dot_product_attention — sequence-parallel when the executor's
+    mesh has an 'sp' axis, as ring attention (sp_mode='ring') or Ulysses
+    all-to-all head re-sharding (sp_mode='alltoall')."""
     helper = LayerHelper("multi_head_attention", name=name)
+    if sp_mode not in ("ring", "alltoall"):
+        raise ValueError(f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
     D = queries.shape[-1]
     assert D % num_heads == 0, "hidden size must divide num_heads"
     q = fc(queries, D, num_flatten_dims=2, param_attr=param_attr,
@@ -323,7 +326,7 @@ def multi_head_attention(queries, keys, values, num_heads, causal=False,
         "scaled_dot_product_attention",
         inputs={"Q": [qh.name], "K": [kh.name], "V": [vh.name]},
         outputs={"Out": [attn.name]},
-        attrs={"causal": causal},
+        attrs={"causal": causal, "sp_mode": sp_mode},
     )
     back = helper.create_tmp_variable(queries.dtype)
     helper.append_op("transpose", inputs={"X": [attn.name]},

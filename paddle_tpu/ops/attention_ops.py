@@ -95,18 +95,29 @@ def attention_gru_decoder(ctx, ins, attrs):
 def scaled_dot_product_attention(ctx, ins, attrs):
     """Multi-head attention core: Q,K,V [B,H,T,D] → [B,H,T,D].
 
-    Under a ParallelExecutor whose mesh has an 'sp' axis > 1, dispatches to
-    ring attention (parallel/ring_attention.py) — the sequence axis stays
-    sharded and K/V chunks rotate over ICI; otherwise dense flash-style
-    softmax (XLA fuses it)."""
+    Under a ParallelExecutor whose mesh has an 'sp' axis > 1, dispatches by
+    the `sp_mode` attr: 'ring' (default — K/V chunks rotate over ICI,
+    memory O(T/S), parallel/ring_attention.py) or 'alltoall'
+    (Ulysses-style — one all_to_all pair re-shards seq→heads, dense local
+    attention; the better trade when heads >= sp and chunks are small).
+    Otherwise dense flash-style softmax (XLA fuses it)."""
     from ..parallel import ring_attention as ra
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     causal = bool(attrs.get("causal", False))
+    sp_mode = str(attrs.get("sp_mode", "ring"))
     mesh = getattr(ctx, "mesh", None)
     if mesh is not None and "sp" in mesh.axis_names and (
             dict(zip(mesh.axis_names, mesh.devices.shape))["sp"] > 1):
-        out = ra.ring_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+        if sp_mode == "alltoall":
+            out = ra.ulysses_attention(q, k, v, mesh, axis_name="sp",
+                                       causal=causal)
+        elif sp_mode == "ring":
+            out = ra.ring_attention(q, k, v, mesh, axis_name="sp",
+                                    causal=causal)
+        else:
+            raise ValueError(
+                f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
     else:
         out = ra.attention(q, k, v, causal=causal)
     return {"Out": [out]}

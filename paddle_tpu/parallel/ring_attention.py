@@ -102,3 +102,58 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def _ulysses_body(q, k, v, axis_name: str, causal: bool, scale):
+    """Per-shard Ulysses step: inputs arrive seq-sharded [B, H, t, D];
+    all_to_all re-shards to head-sharded [B, H/S, T, D], attention runs
+    dense over the FULL sequence locally, and a second all_to_all restores
+    seq sharding.  One collective pair per layer (vs the ring's S hops) —
+    the better trade when H >= S and T/S chunks are small."""
+    from jax import lax
+
+    # [B, H, t, D] --split heads/concat seq--> [B, H/S, S*t, D]
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    oh = attention(qh, kh, vh, causal=causal, scale=scale)
+    # back: split seq, concat heads
+    return lax.all_to_all(oh, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism:
+    q,k,v [B,H,T,D] with T divisible by mesh[axis_name] and H divisible by
+    mesh[axis_name] → [B,H,T,D].  Numerically identical to dense attention
+    (it IS dense attention, re-sharded head-wise)."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import get_shard_map
+
+    shard_map = get_shard_map()
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = sizes[axis_name]
+    if q.shape[1] % S:
+        raise ValueError(
+            f"ulysses attention: head count {q.shape[1]} must be a "
+            f"multiple of the {axis_name!r} axis size {S}")
+    if q.shape[2] % S:
+        raise ValueError(
+            f"ulysses attention: sequence length {q.shape[2]} must be a "
+            f"multiple of the {axis_name!r} axis size {S}")
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ulysses_body, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

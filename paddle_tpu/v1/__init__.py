@@ -39,3 +39,13 @@ from .data_provider import (CacheType, dense_vector,  # noqa: F401
                             sparse_binary_vector, sparse_float_vector,
                             sparse_value)
 from .trainer import V1Trainer  # noqa: F401
+
+
+def reset_v1_config():
+    """Clear v1 per-config globals (declared outputs + registered data
+    sources) — called by paddle_tpu.reset()."""
+    from . import layers as _layers
+    from .data_provider import reset_data_sources
+
+    _layers._declared_outputs.clear()
+    reset_data_sources()

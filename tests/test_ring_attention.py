@@ -86,3 +86,61 @@ def test_transformer_block_trains_sp_sharded():
         (l,) = pe.run(feed={"x": xs, "y": labels}, fetch_list=[loss])
         losses.append(float(l.item()))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(causal):
+    """All-to-all sequence parallelism IS dense attention re-sharded: exact
+    match (up to float assoc) with the dense reference."""
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring_attention import attention, \
+        ulysses_attention
+
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 8, 16, 4
+    q = rng.randn(B, H, T, D).astype(np.float32)
+    k = rng.randn(B, H, T, D).astype(np.float32)
+    v = rng.randn(B, H, T, D).astype(np.float32)
+    mesh = make_mesh({"sp": 8})
+    got = np.asarray(ulysses_attention(q, k, v, mesh, causal=causal))
+    want = np.asarray(attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+
+    rng = np.random.RandomState(1)
+    q = rng.randn(1, 3, 16, 4).astype(np.float32)  # 3 heads, sp=8
+    mesh = make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="head count"):
+        ulysses_attention(q, q, q, mesh)
+
+
+def test_transformer_block_trains_sp_alltoall():
+    """layers.multi_head_attention(sp_mode='alltoall') trains under an sp
+    mesh through the ParallelExecutor."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import ParallelExecutor
+
+    T, D = 8, 32
+    seq = fluid.layers.data(name="seq", shape=[T, D], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    attn = fluid.layers.multi_head_attention(seq, seq, seq, num_heads=8,
+                                             causal=True,
+                                             sp_mode="alltoall")
+    res = fluid.layers.elementwise_add(seq, attn)
+    flat = fluid.layers.reshape(res, [-1, T * D])
+    logits = fluid.layers.fc(input=flat, size=10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    pe = ParallelExecutor(axes={"dp": 1, "sp": 8})
+    pe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    feed = {"seq": rng.rand(4, T, D).astype(np.float32),
+            "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    losses = [float(np.asarray(pe.run(feed=feed, fetch_list=[loss])[0]
+                               ).reshape(-1)[0]) for _ in range(8)]
+    assert losses[-1] < losses[0]
