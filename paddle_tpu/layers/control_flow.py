@@ -307,3 +307,44 @@ def ifelse(cond_scalar: Variable, true_fn_block, false_fn_block,
                "out_names": out_names, "x_names": ext},
     )
     return out_vars if len(out_vars) > 1 else out_vars[0]
+
+
+@contextlib.contextmanager
+def recompute():
+    """Rematerialization scope (TPU-first memory lever — jax.checkpoint):
+    ops built inside run normally forward, but their activations are NOT
+    kept for backward; the backward pass recomputes the segment from its
+    inputs.  Trades FLOPs for HBM exactly like `jax.checkpoint` because the
+    segment lowers as one checkpointed function (the generic vjp grad then
+    differentiates through it).
+
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(h, 1024, act="relu")
+            h = fluid.layers.fc(h, 1024, act="relu")
+    """
+    program = default_main_program()
+    sub = program.create_block()
+    try:
+        yield
+    finally:
+        program.rollback()
+    parent = program.blocks[sub.parent_idx]
+    # escaping values: everything the segment produces; later consumers read
+    # them from the recompute op's outputs (unused ones are DCE'd by XLA)
+    produced = []
+    for op in sub.ops:
+        for n in op.output_names():
+            if n and n not in produced:
+                produced.append(n)
+    ext = _externals(program, sub, exclude=())
+    # segment outputs must be visible in the parent block for later readers
+    for n in produced:
+        if n in sub.vars and n not in parent.vars:
+            parent.vars[n] = sub.vars[n]
+    parent.append_op(
+        "recompute",
+        inputs={"X": list(ext)},
+        outputs={"Out": list(produced)},
+        attrs={"sub_block": sub.idx, "x_names": list(ext),
+               "out_names": list(produced)},
+    )

@@ -240,3 +240,25 @@ def create_array(ctx, ins, attrs):
     shape = [int(s) for s in attrs["shape"]]  # [cap, ...]
     return {"Out": [jnp.zeros(shape, dtype=np_dtype(
         attrs.get("dtype", "float32")))]}
+
+
+@register_op("recompute")
+def recompute_op(ctx, ins, attrs):
+    """Rematerialization segment (layers.recompute): the sub-block lowers
+    as ONE `jax.checkpoint`-wrapped pure function of its externals, so the
+    backward pass (generic vjp through this op) recomputes the segment's
+    activations instead of keeping them resident in HBM."""
+    import jax
+
+    sub_block = int(attrs["sub_block"])
+    x_names = list(attrs["x_names"])
+    out_names = list(attrs["out_names"])
+
+    @jax.checkpoint
+    def segment(*vals):
+        env = dict(zip(x_names, vals))
+        ctx.lower_block(sub_block, env)
+        return tuple(env[n] for n in out_names)
+
+    outs = segment(*ins["X"])
+    return {"Out": list(outs)}

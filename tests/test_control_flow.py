@@ -131,3 +131,45 @@ def test_array_ops_roundtrip():
     np.testing.assert_allclose(w[2], xv)
     np.testing.assert_allclose(w[0], 0)
     np.testing.assert_allclose(r, xv)
+
+
+def test_recompute_matches_plain():
+    """layers.recompute: identical forward/backward numerics to the plain
+    graph (it only changes what's kept in memory), grads flow through."""
+    import paddle_tpu as fluid
+
+    def build(remat):
+        fluid.reset()
+        fluid.default_startup_program().random_seed = 9
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="tanh")
+        if remat:
+            with fluid.layers.recompute():
+                h = fluid.layers.fc(input=h, size=32, act="tanh")
+                h = fluid.layers.fc(input=h, size=32, act="relu")
+        else:
+            h = fluid.layers.fc(input=h, size=32, act="tanh")
+            h = fluid.layers.fc(input=h, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 16).astype(np.float32)
+    ys = rng.rand(8, 1).astype(np.float32)
+
+    results = {}
+    for remat in (False, True):
+        loss = build(remat)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        results[remat] = [
+            float(np.asarray(exe.run(feed={"x": xs, "y": ys},
+                                     fetch_list=[loss])[0]).reshape(-1)[0])
+            for _ in range(6)]
+    np.testing.assert_allclose(results[True], results[False],
+                               rtol=1e-5, atol=1e-6)
+    assert results[True][-1] < results[True][0]
