@@ -337,10 +337,16 @@ def recompute():
             if n and n not in produced:
                 produced.append(n)
     ext = _externals(program, sub, exclude=())
-    # segment outputs must be visible in the parent block for later readers
-    for n in produced:
-        if n in sub.vars and n not in parent.vars:
-            parent.vars[n] = sub.vars[n]
+    # Hoist the segment's vars into the parent block AND rebind their
+    # .block: callers hold Variable objects returned by layers built inside
+    # the scope, and anything later done with them (append_backward,
+    # minimize, fetch) must target the parent, not the sub-block.  Sub-op
+    # metadata lookups still resolve via _find_var_recursive's parent walk.
+    for n, v in list(sub.vars.items()):
+        if n not in parent.vars:
+            v.block = parent
+            parent.vars[n] = v
+        del sub.vars[n]
     parent.append_op(
         "recompute",
         inputs={"X": list(ext)},

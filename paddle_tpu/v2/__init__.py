@@ -19,6 +19,7 @@ from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
 from .parameters import Parameters  # noqa: F401
 from .trainer import SGD, infer  # noqa: F401
+from .inference import SequenceGenerator  # noqa: F401
 
 
 def init(use_gpu=False, trainer_count=1, **kw):

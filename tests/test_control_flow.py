@@ -173,3 +173,27 @@ def test_recompute_matches_plain():
     np.testing.assert_allclose(results[True], results[False],
                                rtol=1e-5, atol=1e-6)
     assert results[True][-1] < results[True][0]
+
+
+def test_recompute_loss_built_inside_scope():
+    """A loss returned from inside recompute() must still minimize
+    correctly (hoisted vars rebind to the parent block)."""
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    with fluid.layers.recompute():
+        h = fluid.layers.fc(input=x, size=16, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+    assert loss.block is fluid.default_main_program().global_block()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(8, 8).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]
+                               ).reshape(-1)[0]) for _ in range(8)]
+    assert losses[-1] < losses[0]

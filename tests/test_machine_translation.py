@@ -88,3 +88,55 @@ def test_machine_translation_train_and_beam_decode():
         elif n == len(want) and np.array_equal(got[:-1], want[:-1]):
             correct += 1
     assert correct >= 12, f"beam decode only {correct}/16 exact"
+
+
+def test_v2_sequence_generator():
+    """v2 SequenceGenerator wrapper (reference PaddleAPI.h
+    SequenceGenerator:1025): ranked (score, tokens) hypotheses per input
+    over the on-device beam search."""
+    from paddle_tpu import v2
+
+    rng = np.random.RandomState(3)
+    src = fluid.layers.sequence_data(name="src", shape=[1], dtype="int64")
+    tgt = fluid.layers.sequence_data(name="tgt", shape=[1], dtype="int64")
+    tgt_next = fluid.layers.sequence_data(name="tgt_next", shape=[1],
+                                          dtype="int64")
+    model = Seq2SeqAttention(src_vocab=VOCAB, tgt_vocab=VOCAB, emb_dim=24,
+                             hidden=32, attn=24, bos_id=BOS, eos_id=EOS)
+    cost = model.train_cost(src, tgt, tgt_next)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        g_src = fluid.layers.sequence_data(name="src", shape=[1],
+                                           dtype="int64")
+        g_model = Seq2SeqAttention(src_vocab=VOCAB, tgt_vocab=VOCAB,
+                                   emb_dim=24, hidden=32, attn=24,
+                                   bos_id=BOS, eos_id=EOS)
+        ids, scores, lengths = g_model.generate(g_src, beam_size=4,
+                                                max_len=10)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    src_seqs, tgt_in_seqs, tgt_out_seqs = _make_pairs(128, rng)
+    for epoch in range(8):
+        for i in range(0, len(src_seqs), 64):
+            feed = {
+                "src": LoDTensor.from_sequences(src_seqs[i:i+64]),
+                "tgt": LoDTensor.from_sequences(tgt_in_seqs[i:i+64]),
+                "tgt_next": LoDTensor.from_sequences(tgt_out_seqs[i:i+64]),
+            }
+            exe.run(feed=feed, fetch_list=[cost])
+
+    gen = v2.SequenceGenerator(ids, scores, lengths, program=gen_prog,
+                               eos_id=EOS)
+    test_src, _, _ = _make_pairs(4, np.random.RandomState(7), lo=3, hi=5)
+    hyps = gen({"src": LoDTensor.from_sequences(test_src)}, top_k=3)
+    assert len(hyps) == 4
+    for row in hyps:
+        assert 1 <= len(row) <= 3
+        # best-first scores, token lists truncated at their length
+        assert all(row[i][0] >= row[i + 1][0] for i in range(len(row) - 1))
+        for score, toks in row:
+            assert np.isfinite(score)
+            assert all(0 <= t < VOCAB for t in toks)
