@@ -346,7 +346,9 @@ def recompute():
         if n not in parent.vars:
             v.block = parent
             parent.vars[n] = v
-        del sub.vars[n]
+            del sub.vars[n]
+        # name collision with an outer var: keep the shadowing sub var in
+        # place so sub-op metadata lookups still resolve to it
     parent.append_op(
         "recompute",
         inputs={"X": list(ext)},

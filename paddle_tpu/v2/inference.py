@@ -60,9 +60,11 @@ class SequenceGenerator:
             for j in order:
                 toks = [int(t) for t in ids[b, j]]
                 if lengths is not None:
+                    # SentenceLength counts tokens BEFORE the end token
                     toks = toks[: int(lengths[b, j])]
                 elif self.eos_id is not None and self.eos_id in toks:
-                    toks = toks[: toks.index(self.eos_id) + 1]
+                    # same contract: hypotheses exclude the trailing EOS
+                    toks = toks[: toks.index(self.eos_id)]
                 row.append((float(scores[b, j]), toks))
             result.append(row)
         return result
