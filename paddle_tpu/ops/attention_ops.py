@@ -119,7 +119,23 @@ def scaled_dot_product_attention(ctx, ins, attrs):
             raise ValueError(
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
     else:
-        out = ra.attention(q, k, v, causal=causal)
+        out = None
+        if ctx.is_test and ctx.target_platform() == "tpu" and \
+                getattr(ctx, "mesh", None) is None:
+            # inference fast path: the Pallas flash kernel (VMEM-tiled
+            # online softmax).  Training keeps the XLA-fused dense path
+            # (pallas_call has no vjp rule here), and so does any sharded
+            # mesh execution (GSPMD cannot partition the Mosaic call).
+            # Shape gates per the kernel's contract: self-attention
+            # lengths, T tiles of 128, lane-width head dim.
+            T, D = q.shape[2], q.shape[3]
+            if (T % 128 == 0 and D <= 128 and k.shape[2] == T
+                    and v.shape[2] == T):
+                from .pallas_kernels.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=causal)
+        if out is None:
+            out = ra.attention(q, k, v, causal=causal)
     return {"Out": [out]}
 
 

@@ -1,5 +1,7 @@
 """Pallas kernel tests in interpret mode (same code path as the chip)."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -75,3 +77,49 @@ def test_pallas_lstm_usable_gate():
     assert not usable(x, {"gate_activation": "tanh"})
     assert not usable(np.zeros((7, 4, 512), np.float32), {})  # B % 8
     assert not usable(np.zeros((8, 4, 4 * 100), np.float32), {})  # H % 128
+
+
+def test_sdp_op_dispatches_flash_on_tpu_inference(monkeypatch):
+    """The scaled_dot_product_attention emitter takes the Pallas flash path
+    exactly when (inference, TPU target, tile-compatible shapes) — checked
+    by interposing the kernel entry (CPU runs keep the dense path)."""
+    from paddle_tpu.ops import attention_ops
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa_mod
+
+    calls = []
+    real = fa_mod.flash_attention
+
+    def spy(q, k, v, causal=False, **kw):
+        calls.append(q.shape)
+        # run in interpret mode so the check executes on CPU
+        return real(q, k, v, causal=causal, block_q=64, block_k=64,
+                    interpret=True)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(1, 2, 128, 16).astype(np.float32))
+
+    ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=True)
+    monkeypatch.setattr(ctx, "target_platform", lambda: "tpu")
+    out = attention_ops.scaled_dot_product_attention(
+        ctx, {"Q": [q], "K": [q], "V": [q]}, {"causal": True})["Out"][0]
+    assert calls == [(1, 2, 128, 16)]
+    # numerics match dense
+    from paddle_tpu.parallel.ring_attention import attention
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(attention(q, q, q, causal=True)),
+                               rtol=2e-5, atol=2e-5)
+
+    # training mode keeps dense (no new call)
+    ctx2 = reg.EmitContext(jax.random.PRNGKey(0), is_test=False)
+    monkeypatch.setattr(ctx2, "target_platform", lambda: "tpu")
+    attention_ops.scaled_dot_product_attention(
+        ctx2, {"Q": [q], "K": [q], "V": [q]}, {"causal": True})
+    assert len(calls) == 1
+    # odd T keeps dense
+    q2 = jnp.asarray(rng.rand(1, 2, 96, 16).astype(np.float32))
+    attention_ops.scaled_dot_product_attention(
+        ctx, {"Q": [q2], "K": [q2], "V": [q2]}, {"causal": False})
+    assert len(calls) == 1
