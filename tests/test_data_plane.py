@@ -35,7 +35,11 @@ def test_datasets_schema():
     toks, label = next(dataset.imdb.train()())
     assert toks.ndim == 1 and label in (0, 1)
     src, tgt, tgt_next = next(dataset.wmt14.train()())
-    assert len(tgt) == len(tgt_next) == len(src) + 1
+    # mode-independent invariants: tgt_in = <s>+trg, tgt_next = trg+<e>
+    assert len(tgt) == len(tgt_next)
+    assert tgt[0] == dataset.wmt14.BOS and tgt_next[-1] == dataset.wmt14.EOS
+    if dataset.common.data_mode("wmt14") == "synthetic":
+        assert len(tgt) == len(src) + 1  # the reversal surrogate's shape
     sample = next(dataset.movielens.train()())
     assert len(sample) == 8
 
