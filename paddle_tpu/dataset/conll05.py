@@ -2,20 +2,139 @@
 
 Each sample is the reference's 9-slot layout (conll05.py reader_creator):
 word sequence, five predicate-context windows (ctx_n2..ctx_p2), predicate
-id sequence, mark sequence (1 on predicate span), and IOB role labels."""
+id sequence, mark sequence (1 on the predicate window), and IOB role
+labels.
+
+Real data is the public conll05st-tests tarball (reference conll05.py:30
+URL/md5 — only the test split is freely distributable) with gzipped
+`words`/`props` column files; props bracket spans convert to B-/I-/O tags
+and the word/verb/label dicts come from the reference's dict files.
+Fallbacks: legacy pkl cache, then the synthetic surrogate."""
 
 from __future__ import annotations
 
+import gzip
+import tarfile
+
 import numpy as np
 
-from .common import has_cached, load_cached, synthetic_rng
+from .common import DATA_MODE, fetch, has_cached, load_cached, synthetic_rng
+
+DATA_URL = "http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz"
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+WORDDICT_URL = ("http://paddlepaddle.bj.bcebos.com/demo/"
+                "srl_dict_and_embedding/wordDict.txt")
+WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+VERBDICT_URL = ("http://paddlepaddle.bj.bcebos.com/demo/"
+                "srl_dict_and_embedding/verbDict.txt")
+VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+TRGDICT_URL = ("http://paddlepaddle.bj.bcebos.com/demo/"
+               "srl_dict_and_embedding/targetDict.txt")
+TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+
+WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
 
 WORD_DICT_LEN = 44068   # reference conll05 word dict size
 LABEL_DICT_LEN = 59     # 29 role types x (B,I) + O
 PRED_DICT_LEN = 3162
+UNK_IDX = 0
+
+
+# ---------------------------------------------------------------- parsing
+def brackets_to_iob(tags):
+    """One predicate's bracket column ('(A0*', '*', '*)', '(V*)') -> B-/I-/O
+    tags (the conll05 span encoding)."""
+    out, cur, inside = [], "O", False
+    for t in tags:
+        if t == "*":
+            out.append("I-" + cur if inside else "O")
+        elif t == "*)":
+            out.append("I-" + cur)
+            inside = False
+        elif "(" in t:
+            cur = t[1:t.index("*")]
+            out.append("B-" + cur)
+            inside = ")" not in t
+        else:
+            raise ValueError(f"unexpected props tag {t!r}")
+    return out
+
+
+def _sentences(path, words_member, props_member):
+    """Yield (words, verb_lemma, iob_labels) per predicate per sentence."""
+    with tarfile.open(path) as tf:
+        wf = gzip.GzipFile(fileobj=tf.extractfile(words_member))
+        pf = gzip.GzipFile(fileobj=tf.extractfile(props_member))
+        words, cols = [], []
+        for wline, pline in zip(wf, pf):
+            w = wline.strip().decode("utf-8", "replace")
+            parts = pline.strip().decode("utf-8", "replace").split()
+            if not w:
+                yield from _emit(words, cols)
+                words, cols = [], []
+            else:
+                words.append(w)
+                cols.append(parts)
+        yield from _emit(words, cols)
+
+
+def _emit(words, cols):
+    if not cols:
+        return
+    lemmas = [r[0] for r in cols]
+    verbs = [x for x in lemmas if x != "-"]
+    for k in range(1, len(cols[0])):
+        yield words, verbs[k - 1], brackets_to_iob([r[k] for r in cols])
+
+
+def _load_dict_file(path):
+    with open(path) as f:
+        return {line.strip(): i for i, line in enumerate(f)}
+
+
+def _window_sample(sentence, predicate, labels, word_dict, verb_dict,
+                  label_dict):
+    """The reference reader_creator's 9-slot construction: five context
+    words around the B-V position (bos/eos at edges), the 5-token mark."""
+    n = len(sentence)
+    v = labels.index("B-V")
+    mark = [0] * n
+
+    def at(i, edge):
+        if 0 <= i < n:
+            mark[i] = 1
+            return sentence[i]
+        return edge
+
+    ctx = [at(v - 2, "bos"), at(v - 1, "bos"), at(v, "bos"),
+           at(v + 1, "eos"), at(v + 2, "eos")]
+    wi = np.asarray([word_dict.get(w, UNK_IDX) for w in sentence], np.int64)
+    ctx_cols = [np.full(n, word_dict.get(c, UNK_IDX), np.int64) for c in ctx]
+    pred = np.full(n, verb_dict.get(predicate, UNK_IDX), np.int64)
+    lab = np.asarray([label_dict.get(x, 0) for x in labels], np.int64)
+    return (wi, ctx_cols[0], ctx_cols[1], ctx_cols[2], ctx_cols[3],
+            ctx_cols[4], pred, np.asarray(mark, np.int64), lab)
+
+
+# ------------------------------------------------------------------- dicts
+def _real_dicts():
+    """The reference's three dict files, or None when any is unfetchable."""
+    wp = fetch(WORDDICT_URL, "conll05", WORDDICT_MD5)
+    vp = fetch(VERBDICT_URL, "conll05", VERBDICT_MD5)
+    tp = fetch(TRGDICT_URL, "conll05", TRGDICT_MD5)
+    if wp and vp and tp:
+        return (_load_dict_file(wp), _load_dict_file(vp),
+                _load_dict_file(tp))
+    return None
 
 
 def get_dict():
+    """word/verb/label dicts — the reference's dict files when fetchable,
+    index surrogates otherwise."""
+    real = _real_dicts()
+    if real is not None:
+        return real
     word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
     verb_dict = {f"v{i}": i for i in range(PRED_DICT_LEN)}
     label_dict = {f"l{i}": i for i in range(LABEL_DICT_LEN)}
@@ -30,6 +149,7 @@ def get_embedding():
     return rng.uniform(-1, 1, (WORD_DICT_LEN, 32)).astype(np.float32)
 
 
+# ----------------------------------------------------------------- readers
 def _synthetic(n, seed):
     rng = synthetic_rng("conll05", seed)
     out = []
@@ -59,8 +179,24 @@ def _synthetic(n, seed):
 
 def _reader(n, seed, fname):
     def reader():
-        data = (load_cached("conll05", fname)
-                if has_cached("conll05", fname) else _synthetic(n, seed))
+        path = fetch(DATA_URL, "conll05", DATA_MD5)
+        dicts = _real_dicts() if path is not None else None
+        if path is not None and dicts is not None:
+            # real corpus requires the real dicts: mapping real words
+            # through index surrogates would silently yield all-UNK samples
+            DATA_MODE["conll05"] = "real"
+            word_dict, verb_dict, label_dict = dicts
+            for sentence, predicate, labels in _sentences(
+                    path, WORDS_MEMBER, PROPS_MEMBER):
+                yield _window_sample(sentence, predicate, labels,
+                                     word_dict, verb_dict, label_dict)
+            return
+        if has_cached("conll05", fname):
+            DATA_MODE["conll05"] = "cache"
+            data = load_cached("conll05", fname)
+        else:
+            DATA_MODE["conll05"] = "synthetic"
+            data = _synthetic(n, seed)
         for sample in data:
             yield sample
 

@@ -292,3 +292,68 @@ def test_wmt14_parses_real_tgz(data_home, monkeypatch):
     assert src2.tolist() == [0, 3, 4, 2, 1]
     assert tgt_in2.tolist() == [0, 3, 2, 4]
     assert len(list(wmt14.test(dict_size=6)())) == 1
+
+
+# ----------------------------------------------------------------- conll05
+def test_conll05_parses_real_props(data_home, monkeypatch):
+    from paddle_tpu.dataset import conll05
+
+    d = data_home / "conll05"
+    d.mkdir()
+    # two-sentence corpus; sentence 1 has two predicates (two prop columns,
+    # one lemma row per predicate)
+    words = "The\ncat\nchased\nmice\nand\nfled\n\nDogs\nbark\n\n"
+    props = ("-\t(A0*\t*\n"
+             "-\t*)\t(A0*)\n"
+             "chase\t(V*)\t*\n"
+             "-\t(A1*)\t*\n"
+             "-\t*\t*\n"
+             "flee\t*\t(V*)\n"
+             "\n"
+             "-\t(A0*)\n"
+             "bark\t(V*)\n"
+             "\n")
+    wgz, pgz = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wgz, mode="wb") as f:
+        f.write(words.encode())
+    with gzip.GzipFile(fileobj=pgz, mode="wb") as f:
+        f.write(props.encode())
+    tar_path = d / "conll05st-tests.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, blob in ((conll05.WORDS_MEMBER, wgz.getvalue()),
+                           (conll05.PROPS_MEMBER, pgz.getvalue())):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    monkeypatch.setattr(conll05, "DATA_MD5", common.md5file(str(tar_path)))
+
+    # reference-style dict files alongside the corpus
+    (d / "wordDict.txt").write_text(
+        "\n".join(["<unk>", "The", "cat", "chased", "mice", "and", "fled",
+                   "Dogs", "bark", "bos", "eos"]) + "\n")
+    (d / "verbDict.txt").write_text("\n".join(["<unk>", "chase", "flee",
+                                               "bark"]) + "\n")
+    (d / "targetDict.txt").write_text(
+        "\n".join(["O", "B-A0", "I-A0", "B-A1", "I-A1", "B-V", "I-V"])
+        + "\n")
+    monkeypatch.setattr(conll05, "WORDDICT_MD5",
+                        common.md5file(str(d / "wordDict.txt")))
+    monkeypatch.setattr(conll05, "VERBDICT_MD5",
+                        common.md5file(str(d / "verbDict.txt")))
+    monkeypatch.setattr(conll05, "TRGDICT_MD5",
+                        common.md5file(str(d / "targetDict.txt")))
+
+    samples = list(conll05.test()())
+    assert common.data_mode("conll05") == "real"
+    # 2 predicates in sentence 1 + 1 in sentence 2
+    assert len(samples) == 3
+    for s in samples:
+        assert len(s) == 9
+        n = len(s[0])
+        assert all(len(col) == n for col in s[1:])
+    # sentence 1, predicate 'chase' at index 2: the 5-token window marks
+    # tokens 0..4 of the 6-token sentence
+    words_ids, *_ctx, pred, mark, labels = samples[0]
+    assert mark.tolist() == [1, 1, 1, 1, 1, 0]
+    # bracket->IOB gave at least B-A0/I-A0, B-V, B-A1 and O distinct codes
+    assert len(set(labels.tolist())) >= 3
