@@ -177,10 +177,22 @@ def _synthetic(n, seed):
     return out
 
 
+_dicts_cache: dict = {}
+
+
+def _real_dicts_cached():
+    """One fetch+parse of the three dict files per process, not per epoch
+    (keyed on the expected checksums so a changed config reloads)."""
+    key = (WORDDICT_MD5, VERBDICT_MD5, TRGDICT_MD5)
+    if key not in _dicts_cache:
+        _dicts_cache[key] = _real_dicts()
+    return _dicts_cache[key]
+
+
 def _reader(n, seed, fname):
     def reader():
         path = fetch(DATA_URL, "conll05", DATA_MD5)
-        dicts = _real_dicts() if path is not None else None
+        dicts = _real_dicts_cached() if path is not None else None
         if path is not None and dicts is not None:
             # real corpus requires the real dicts: mapping real words
             # through index surrogates would silently yield all-UNK samples

@@ -121,12 +121,14 @@ class DetectionMAP:
     11-point or integral interpolation."""
 
     def __init__(self, overlap_threshold=0.5, ap_version="integral",
-                 evaluate_difficult=False):
+                 evaluate_difficult=False, background_label=None):
         self.overlap_threshold = float(overlap_threshold)
         self.ap_version = ap_version
         # VOC semantics: difficult gts count toward npos only when True;
         # when False a detection matching a difficult gt is neither TP nor FP
         self.evaluate_difficult = bool(evaluate_difficult)
+        # class id excluded from scoring (the v1 evaluator's background_id)
+        self.background_label = background_label
         self.reset()
 
     def reset(self, executor=None, reset_program=None):
@@ -164,7 +166,8 @@ class DetectionMAP:
         return inter / max(ua + ub - inter, 1e-10)
 
     def eval(self, executor=None):
-        classes = sorted({c for _, c, *_ in self._gts})
+        classes = sorted({c for _, c, *_ in self._gts
+                          if c != self.background_label})
         aps = []
         for cls in classes:
             gts = [(img, box, diff) for img, c, box, diff in self._gts

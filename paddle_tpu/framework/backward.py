@@ -120,6 +120,13 @@ def append_backward(
                 "sum", inputs={"X": list(parts)}, outputs={"Out": [gname]}
             )
         finalized.add(name)
+        # v1 gradient_printer_evaluator support: vars tagged print_gradient
+        # get a runtime print of their materialized grad
+        v = block._find_var_recursive(name)
+        if v is not None and getattr(v, "print_gradient", False):
+            block.append_op(
+                "print", inputs={"X": [gname]}, outputs={"Out": [gname]},
+                attrs={"message": f"{gname}: "})
         return gname
 
     def record(name: str, grad_name: str):

@@ -103,3 +103,42 @@ def elementwise_mul(x, y, axis=-1, act=None, name=None):
 
 def elementwise_div(x, y, axis=-1, act=None, name=None):
     return elementwise_op("elementwise_div", x, y, axis, act, name)
+
+
+def _reduce_layer(op_type, x, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if x.shape is None:
+        oshape = None
+    elif dim is None:
+        # full reduction: rank-1 [1] (op reshapes), or all-ones with keep_dim
+        oshape = tuple(1 for _ in x.shape) if keep_dim else (1,)
+    else:
+        dims = [dim] if isinstance(dim, int) else list(dim)
+        dims = [d % len(x.shape) for d in dims]
+        oshape = tuple(
+            (1 if keep_dim else None) if i in dims else s
+            for i, s in enumerate(x.shape))
+        oshape = tuple(s for s in oshape if s is not None) or (1,)
+    out = helper.create_tmp_variable(x.dtype, shape=oshape)
+    helper.append_op(op_type, inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"dim": dim, "keep_dim": keep_dim,
+                            "reduce_all": dim is None})
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    """fluid layers reduce_sum (reference nn.py reduce_sum)."""
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)

@@ -137,7 +137,12 @@ def _reduce(fn):
             axis = None
         else:
             axis = tuple(dim) if isinstance(dim, (list, tuple)) else int(dim)
-        return {"Out": [fn(x, axis, keep)]}
+        out = fn(x, axis, keep)
+        if axis is None and not keep:
+            # reference reduce_op.cc: a full reduction yields rank-1 [1],
+            # not a 0-d scalar — downstream layers rely on that rank
+            out = out.reshape((1,))
+        return {"Out": [out]}
 
     return emit
 

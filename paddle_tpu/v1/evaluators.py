@@ -89,3 +89,82 @@ def ctc_error_evaluator(input, label, name=None):
         outputs={"Out": [dist.name], "SequenceNum": [seqn.name]},
         attrs={"normalized": True})
     return dist
+
+
+def detection_map_evaluator(input=None, label=None, background_id=0,
+                            overlap_threshold=0.5, ap_version="integral",
+                            evaluate_difficult=False, name=None, **kw):
+    """Detection mAP (reference evaluators.py detection_map_evaluator).
+
+    Evaluators were host-side C++ accumulators in the reference; the fluid
+    DetectionMAP here is the same shape: feed each batch's fetched
+    `detection_output` slate + ground truth via `.add_batch(...)`, read
+    `.eval()`.  The graph inputs are accepted for config-API parity."""
+    from ..evaluator import DetectionMAP
+
+    return DetectionMAP(overlap_threshold=overlap_threshold,
+                        ap_version=ap_version,
+                        evaluate_difficult=evaluate_difficult,
+                        background_label=background_id)
+
+
+def sum_evaluator(input, name=None):
+    """Sum of the input over the batch (evaluators.py sum_evaluator)."""
+    from .. import layers as fl
+
+    return fl.reduce_sum(_var(input), dim=None)
+
+
+def column_sum_evaluator(input, name=None):
+    """Per-column sum over the batch (evaluators.py column_sum_evaluator)."""
+    from .. import layers as fl
+
+    return fl.reduce_sum(_var(input), dim=0)
+
+
+# --- printer evaluators (reference evaluators.py *_printer_evaluator):
+# runtime prints from inside the compiled program via the print op ---------
+
+def _print_on(var, message):
+    from ..framework.layer_helper import LayerHelper
+
+    helper = LayerHelper("print_eval")
+    out = helper.create_tmp_variable(var.dtype, shape=var.shape)
+    helper.append_op("print", inputs={"X": [var.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"message": message})
+    return out
+
+
+def value_printer_evaluator(input, name=None):
+    v = _var(input)
+    return _print_on(v, f"{name or v.name}: ")
+
+
+def maxid_printer_evaluator(input, num_results=1, name=None):
+    """Print the arg-max ids of each row (maxid_printer)."""
+    from .. import layers as fl
+
+    v = _var(input)
+    _, idx = fl.topk(v, k=num_results)
+    return _print_on(idx, f"{name or v.name} maxid: ")
+
+
+def seqtext_printer_evaluator(input, result_file=None, name=None):
+    """Print id sequences (seqtext_printer; file redirection is the
+    caller's stdout redirect here — prints ride the compiled program)."""
+    v = _var(input)
+    return _print_on(v, f"{name or v.name} seq: ")
+
+
+def classification_error_printer_evaluator(input, label, name=None):
+    err = classification_error_evaluator(input, label)
+    return _print_on(err, f"{name or 'classification_error'}: ")
+
+
+def gradient_printer_evaluator(input, name=None):
+    """Tag the var so append_backward prints its materialized gradient
+    (reference gradient_printer_evaluator)."""
+    v = _var(input)
+    v.print_gradient = True
+    return v

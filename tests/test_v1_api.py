@@ -222,3 +222,43 @@ def test_v1_selective_fc():
                          "ssel": mask}, fetch_list=[out.var])
     assert o.shape == (2, 10)
     assert np.all(o[:, 3:] == 0) and np.any(o[:, :3] != 0)
+
+
+def test_v1_extra_evaluators(capfd):
+    """sum/column_sum/printer/gradient-printer evaluators (reference
+    evaluators.py breadth)."""
+    import numpy as np
+    from paddle_tpu import v1
+    from paddle_tpu.v1 import evaluators as ev
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    hid = fluid.layers.fc(input=x, size=8, act="tanh")
+    ev.gradient_printer_evaluator(hid)
+    prob = fluid.layers.fc(input=hid, size=3, act="softmax")
+    s = ev.sum_evaluator(prob)
+    cs = ev.column_sum_evaluator(prob)
+    vp = ev.value_printer_evaluator(prob, name="probs")
+    mp = ev.maxid_printer_evaluator(prob)
+    cep = ev.classification_error_printer_evaluator(prob, y)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(6, 4).astype(np.float32),
+            "y": rng.randint(0, 3, (6, 1)).astype(np.int64)}
+    out = exe.run(feed=feed, fetch_list=[s, cs, loss])
+    np.testing.assert_allclose(float(np.asarray(out[0])), 6.0, rtol=1e-4)
+    assert np.asarray(out[1]).shape == (3,)
+    np.testing.assert_allclose(np.asarray(out[1]).sum(), 6.0, rtol=1e-4)
+    captured = capfd.readouterr()
+    text = captured.out + captured.err
+    assert "probs" in text           # value printer ran
+    assert "maxid" in text           # maxid printer ran
+    assert "classification_error" in text
+    assert "@GRAD" in text           # gradient printer ran in backward
+
+    mAP = ev.detection_map_evaluator(overlap_threshold=0.5)
+    assert hasattr(mAP, "add_batch") and hasattr(mAP, "eval")
