@@ -357,3 +357,136 @@ def test_conll05_parses_real_props(data_home, monkeypatch):
     assert mark.tolist() == [1, 1, 1, 1, 1, 0]
     # bracket->IOB gave at least B-A0/I-A0, B-V, B-A1 and O distinct codes
     assert len(set(labels.tolist())) >= 3
+
+
+# ----------------------------------------------------------------- flowers
+def test_flowers_parses_real_archives(data_home, monkeypatch):
+    import scipy.io as scio
+    from PIL import Image
+
+    from paddle_tpu.dataset import flowers
+
+    d = data_home / "flowers"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    tgz = d / "102flowers.tgz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        for i in (1, 2, 3):
+            img = Image.fromarray(
+                rng.randint(0, 255, (32, 40, 3), dtype=np.uint8))
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG")
+            blob = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    scio.savemat(d / "imagelabels.mat",
+                 {"labels": np.asarray([[5, 17, 5]], np.uint8)})
+    scio.savemat(d / "setid.mat",
+                 {"tstid": np.asarray([[1, 3]]),     # -> train (swapped)
+                  "trnid": np.asarray([[2]]),        # -> test
+                  "valid": np.asarray([[2]])})
+    monkeypatch.setattr(flowers, "DATA_MD5", common.md5file(str(tgz)))
+    monkeypatch.setattr(flowers, "LABEL_MD5",
+                        common.md5file(str(d / "imagelabels.mat")))
+    monkeypatch.setattr(flowers, "SETID_MD5",
+                        common.md5file(str(d / "setid.mat")))
+
+    train = list(flowers.train()())
+    assert common.data_mode("flowers") == "real"
+    assert len(train) == 2
+    img, label = train[0]
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    assert label == 4  # 1-based 5 -> 0-based 4
+    test = list(flowers.test()())
+    assert len(test) == 1 and test[0][1] == 16
+
+
+# ----------------------------------------------------------------- voc2012
+def test_voc2012_parses_real_tar(data_home, monkeypatch):
+    from PIL import Image
+
+    from paddle_tpu.dataset import voc2012
+
+    d = data_home / "voc2012"
+    d.mkdir()
+    rng = np.random.RandomState(1)
+    tar_path = d / "VOCtrainval_11-May-2012.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        def add(name, blob):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+        add(voc2012.SET_FILE.format("trainval"), b"img_a\nimg_b\n")
+        add(voc2012.SET_FILE.format("train"), b"img_a\n")
+        add(voc2012.SET_FILE.format("val"), b"img_b\n")
+        for name in ("img_a", "img_b"):
+            im = Image.fromarray(rng.randint(0, 255, (24, 30, 3),
+                                             dtype=np.uint8))
+            buf = io.BytesIO()
+            im.save(buf, format="JPEG")
+            add(voc2012.DATA_FILE.format(name), buf.getvalue())
+            mask = np.zeros((24, 30), np.uint8)
+            mask[4:10, 5:12] = 7            # class 7 object
+            mask[4, 5:12] = 255             # ignore border
+            # grayscale PNG: PIL's palette-PNG writer remaps small palettes
+            # (index 7 -> 1), but np.asarray reads raw values from "L" just
+            # like it reads indices from real VOC's full-palette "P" files
+            pim = Image.fromarray(mask, mode="L")
+            buf = io.BytesIO()
+            pim.save(buf, format="PNG")
+            add(voc2012.LABEL_FILE.format(name), buf.getvalue())
+    monkeypatch.setattr(voc2012, "VOC_MD5", common.md5file(str(tar_path)))
+
+    train = list(voc2012.train()())
+    assert common.data_mode("voc2012") == "real"
+    assert len(train) == 2
+    img, mask = train[0]
+    assert img.shape == (3, 24, 30) and img.dtype == np.float32
+    assert mask.shape == (24, 30) and mask.dtype == np.int32
+    assert set(np.unique(mask)) == {0, 7, 255}
+    assert len(list(voc2012.val()())) == 1
+    assert len(list(voc2012.test()())) == 1
+
+
+# ---------------------------------------------------------------- sentiment
+def test_sentiment_real_path_or_fallback(data_home, monkeypatch):
+    """movie_reviews via NLTK when installed; otherwise a clean synthetic
+    fallback with mode reporting (both paths legal)."""
+    from paddle_tpu.dataset import sentiment
+
+    samples = list(sentiment.test(n=8)())
+    mode = common.data_mode("sentiment")
+    assert mode in ("real", "synthetic", "cache")
+    if mode == "real":
+        assert len(samples) == 400
+    else:
+        assert len(samples) == 8
+    ids, label = samples[0]
+    assert np.asarray(ids).dtype == np.int64 and label in (0, 1)
+
+
+# ------------------------------------------------------------------- mq2007
+def test_mq2007_parses_letor_text(data_home, monkeypatch):
+    from paddle_tpu.dataset import mq2007
+
+    d = data_home / "mq2007" / "Fold1"  # fixture repoints common.DATA_HOME
+    d.mkdir(parents=True)
+    (d / "train.txt").write_text(
+        "2 qid:10 1:0.1 2:0.5 46:0.9 #docid = A\n"
+        "0 qid:10 1:0.0 2:0.1 #docid = B\n"
+        "1 qid:11 3:0.7 #docid = C\n")
+
+    listwise = list(mq2007.train(format="listwise")())
+    assert common.data_mode("mq2007") == "real"
+    assert len(listwise) == 2  # two queries
+    labels, feats = listwise[0]
+    assert list(labels) == [2, 0]
+    assert feats[0].shape == (46,) and abs(feats[0][45] - 0.9) < 1e-6
+
+    pairs = list(mq2007.train(format="pairwise")())
+    assert len(pairs) == 1  # only qid:10 has a (2 > 0) pair
+    points = list(mq2007.train(format="pointwise")())
+    assert len(points) == 3
