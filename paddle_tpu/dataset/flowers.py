@@ -40,12 +40,15 @@ def _decode_jpeg(blob) -> np.ndarray:
 
 
 def _real_samples(split_flag):
-    import scipy.io as scio
-
     data = fetch(DATA_URL, "flowers", DATA_MD5)
     labels_p = fetch(LABEL_URL, "flowers", LABEL_MD5)
     setid_p = fetch(SETID_URL, "flowers", SETID_MD5)
     if not (data and labels_p and setid_p):
+        return None
+    try:  # decode deps only needed once real archives are present
+        import scipy.io as scio
+        from PIL import Image  # noqa: F401
+    except ImportError:
         return None
     labels = scio.loadmat(labels_p)["labels"][0]          # 1-based classes
     ids = scio.loadmat(setid_p)[split_flag][0]            # 1-based image ids

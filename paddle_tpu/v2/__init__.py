@@ -14,9 +14,17 @@ from .. import dataset  # noqa: F401
 from .. import optimizer  # noqa: F401
 from .. import reader  # noqa: F401
 from ..reader import batch  # noqa: F401
+from . import activation  # noqa: F401
+from . import attr  # noqa: F401
+from . import data_type  # noqa: F401
 from . import event  # noqa: F401
+from . import layer  # noqa: F401
+from . import pooling  # noqa: F401
 from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
+from ..v1 import networks  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import parameters  # noqa: F401
 from .parameters import Parameters  # noqa: F401
 from .trainer import SGD, infer  # noqa: F401
 from .inference import SequenceGenerator  # noqa: F401

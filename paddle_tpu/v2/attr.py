@@ -1,0 +1,6 @@
+"""v2 attrs (reference python/paddle/v2/attr.py)."""
+
+from ..v1.attrs import (ExtraAttr as Extra,  # noqa: F401
+                        ExtraLayerAttribute as ExtraAttribute,
+                        ParamAttr as Param,
+                        ParameterAttribute as ParamAttribute)

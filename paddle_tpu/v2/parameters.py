@@ -62,3 +62,11 @@ class Parameters:
     def from_tar_new(f, program=None):
         p = Parameters(program)
         return p.from_tar(f)
+
+
+def create(layers):
+    """reference parameters.py:27 create(): Parameters for the program the
+    given output layer(s) belong to."""
+    ls = layers if isinstance(layers, (list, tuple)) else [layers]
+    var = getattr(ls[0], "var", ls[0])
+    return Parameters(var.block.program)

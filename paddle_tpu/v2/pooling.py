@@ -1,0 +1,8 @@
+"""v2 poolings (reference python/paddle/v2/pooling.py)."""
+
+from ..v1.poolings import (AvgPooling as Avg,  # noqa: F401
+                           FirstPooling as First,
+                           LastPooling as Last,
+                           MaxPooling as Max,
+                           SqrtAvgPooling as SqrtAvg,
+                           SumPooling as Sum)

@@ -111,6 +111,11 @@ def infer(output_layer, parameters: Parameters, input, feeding=None,
     data_vars = [v.name for v in program.global_block().vars.values()
                  if v.is_data and v.name in used
                  and not v.name.endswith("@LENGTH")]
+    if feeding:
+        # samples may carry columns for vars the pruned inference program
+        # no longer uses (e.g. the label): select this program's columns
+        order = [feeding[n] for n in data_vars]
+        input = [tuple(sample[i] for i in order) for sample in input]
     feeder = DataFeeder(feed_list=data_vars, program=program)
     (out,) = exe.run(program, feed=feeder.feed(input),
                      fetch_list=[output_layer])

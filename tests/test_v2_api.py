@@ -75,3 +75,52 @@ def test_v2_infer():
                        input=samples)
     assert out.shape == (8, 1)
     assert np.isfinite(out).all()
+
+
+def test_v2_book_style_api():
+    """The reference v2 book idiom runs as written: layer.data with
+    data_type slots, activation objects, parameters.create, trainer.SGD
+    over a batched reader (reference v2/tests/test_layer.py style)."""
+    import numpy as np
+
+    import paddle_tpu.v2 as paddle
+
+    pixel = paddle.layer.data(name="pixel",
+                              type=paddle.data_type.dense_vector(64))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(4))
+    hidden = paddle.layer.fc(input=pixel, size=32,
+                             act=paddle.activation.Sigmoid())
+    inference = paddle.layer.fc(input=hidden, size=4,
+                                act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=inference, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    assert len(parameters.names()) >= 4  # two fc layers' w+b
+
+    rng = np.random.RandomState(0)
+    temps = rng.rand(4, 64)
+
+    def reader():
+        for _ in range(128):
+            y = rng.randint(0, 4)
+            yield (temps[y] + 0.1 * rng.rand(64)).astype(np.float32), y
+
+    trainer = paddle.trainer.SGD(
+        cost=cost.var, parameters=parameters,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1,
+                                                  momentum=0.9))
+    seen = []
+    trainer.train(paddle.batch(reader, batch_size=32), num_passes=6,
+                  event_handler=lambda e: seen.append(e),
+                  feeding={"pixel": 0, "label": 1})
+    costs = [e.cost for e in seen
+             if isinstance(e, paddle.event.EndIteration)]
+    assert costs[-1] < costs[0]
+
+    # v2 inference over the trained parameters
+    probs = paddle.infer(output_layer=inference.var,
+                         parameters=parameters,
+                         input=[(temps[2].astype(np.float32),)],
+                         feeding={"pixel": 0})
+    assert np.asarray(probs).shape[-1] == 4
