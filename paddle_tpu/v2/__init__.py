@@ -18,6 +18,7 @@ from . import activation  # noqa: F401
 from . import attr  # noqa: F401
 from . import data_type  # noqa: F401
 from . import event  # noqa: F401
+from . import image  # noqa: F401
 from . import layer  # noqa: F401
 from . import pooling  # noqa: F401
 from . import plot  # noqa: F401

@@ -13,7 +13,8 @@ IS the parsed network, so this is a naming shim plus the type-driven
 from __future__ import annotations
 
 from ..v1 import layers as _v1
-from ..v1.data_provider import InputType, _Integer, _IntegerSeq
+from ..v1.data_provider import (InputType, _Integer, _IntegerSeq,
+                                _SparseBinary, _SparseFloat)
 
 __all__ = ["data", "parse_network"]
 

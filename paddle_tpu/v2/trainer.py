@@ -30,6 +30,7 @@ class SGD:
     def __init__(self, cost, parameters: Optional[Parameters] = None,
                  update_equation=None, extra_layers: Sequence = (),
                  is_local=True, place=None):
+        cost = getattr(cost, "var", cost)  # accept v2 LayerOutput
         self.cost = cost
         self.program = cost.block.program
         self.parameters = parameters or Parameters(self.program)
@@ -102,6 +103,7 @@ def infer(output_layer, parameters: Parameters, input, feeding=None,
     """v2 inference.py equivalent: run the forward program on raw samples."""
     from .. import io as fio
 
+    output_layer = getattr(output_layer, "var", output_layer)
     program = output_layer.block.program.clone(for_test=True)
     program = fio.prune(program, [output_layer.name])
     exe = Executor(default_place())

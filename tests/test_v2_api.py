@@ -124,3 +124,46 @@ def test_v2_book_style_api():
                          input=[(temps[2].astype(np.float32),)],
                          feeding={"pixel": 0})
     assert np.asarray(probs).shape[-1] == 4
+
+
+def test_v2_image_pipeline(tmp_path):
+    """reference v2/image.py pipeline: resize_short -> crop -> flip -> CHW
+    float32 - mean, plus tar batching."""
+    import tarfile
+
+    import numpy as np
+    from PIL import Image
+
+    from paddle_tpu.v2 import image as v2img
+
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (48, 64, 3), dtype=np.uint8)
+    p = tmp_path / "a.jpg"
+    Image.fromarray(arr).save(p)
+
+    im = v2img.load_image(str(p))
+    assert im.shape == (48, 64, 3)
+    rs = v2img.resize_short(im, 32)
+    assert min(rs.shape[:2]) == 32 and rs.shape[1] > rs.shape[0]
+    cc = v2img.center_crop(rs, 32)
+    assert cc.shape[:2] == (32, 32)
+    out = v2img.simple_transform(im, 40, 32, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+    tr = v2img.simple_transform(im, 40, 32, is_train=True,
+                                rng=np.random.RandomState(1))
+    assert tr.shape == (3, 32, 32)
+    flipped = v2img.left_right_flip(cc)
+    np.testing.assert_array_equal(flipped[:, ::-1], cc)
+
+    # tar batching
+    tarp = tmp_path / "imgs.tar"
+    with tarfile.open(tarp, "w") as tf:
+        tf.add(p, arcname="imgs/a.jpg")
+    meta = v2img.batch_images_from_tar(str(tarp), "toy",
+                                       {"imgs/a.jpg": 3}, num_per_batch=8)
+    import pickle
+    batch_files = open(meta).read().split()
+    rec = pickle.load(open(batch_files[0], "rb"))
+    assert rec["label"] == [3]
+    assert v2img.load_image_bytes(rec["data"][0]).shape == (48, 64, 3)
