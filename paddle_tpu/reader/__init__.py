@@ -16,3 +16,5 @@ from .decorator import (  # noqa: F401
     shuffle,
     xmap_readers,
 )
+
+from . import creator  # noqa: F401

@@ -104,3 +104,35 @@ def test_device_feeder_prefetch():
         n_batches += 1
     assert n_batches == len(list(r()))
     assert np.isfinite(l).all()
+
+
+def test_reader_creators(tmp_path):
+    """reference v2/reader/creator.py surface: np_array, text_file,
+    recordio."""
+    from paddle_tpu.native import recordio as rio
+    from paddle_tpu.reader import creator
+
+    arr = np.arange(6).reshape(3, 2)
+    assert [r.tolist() for r in creator.np_array(arr)()] == \
+        [[0, 1], [2, 3], [4, 5]]
+
+    p = tmp_path / "t.txt"
+    p.write_text("alpha\nbeta\n")
+    assert list(creator.text_file(str(p))()) == ["alpha", "beta"]
+
+    rp = str(tmp_path / "data.rio")
+    with rio.Writer(rp) as w:
+        w.write(b"one")
+        w.write(b"two")
+    assert list(creator.recordio(rp)()) == [b"one", b"two"]
+
+
+def test_reader_creator_recordio_glob(tmp_path):
+    from paddle_tpu.native import recordio as rio
+    from paddle_tpu.reader import creator
+
+    for i in range(3):
+        with rio.Writer(str(tmp_path / f"d-{i:05d}-of-00003.rio")) as w:
+            w.write(f"rec{i}".encode())
+    recs = sorted(creator.recordio(str(tmp_path / "d-*-of-00003.rio"))())
+    assert recs == [b"rec0", b"rec1", b"rec2"]
