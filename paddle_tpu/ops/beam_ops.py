@@ -124,3 +124,17 @@ def beam_search_decode(ctx, ins, attrs):
         jnp.cumprod(not_end, axis=-1), axis=-1).astype(jnp.int32)
     return {"SentenceIds": [sent], "SentenceScores": [scores],
             "SentenceLength": [length]}
+
+
+@register_op("beam_expand", grad=None)
+def beam_expand(ctx, ins, attrs):
+    """Beam-lane broadcast [B, ...] -> [B*K, ...]: every hypothesis lane of
+    a sample sees that sample's data (the v1 beam_search StaticInput
+    expansion).  One op instead of unsqueeze/tile/reshape so dynamic
+    trailing dims (padded sequence T) resolve at trace time."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    K = int(attrs["beam_size"])
+    out = jnp.repeat(x[:, None], K, axis=1).reshape((-1,) + x.shape[1:])
+    return {"Out": [out]}

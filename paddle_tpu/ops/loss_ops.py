@@ -421,3 +421,45 @@ def lambda_rank(ctx, ins, attrs):
     loss = jnp.sum(jnp.where(pair_valid, delta_ndcg * pair_loss, 0.0),
                    axis=(1, 2))
     return {"Out": [loss.reshape(-1, 1)]}
+
+
+@register_op("cross_entropy_over_beam",
+             non_diff_inputs=("Ids", "Label", "Length"))
+def cross_entropy_over_beam(ctx, ins, attrs):
+    """Cross-entropy over one beam expansion (reference
+    gserver/layers/CrossEntropyOverBeam.cpp, layers.py
+    cross_entropy_over_beam:5804): softmax over the scores of the
+    beam-selected candidates, negative log-likelihood of the gold
+    candidate's slot.  A gold that fell out of the beam contributes a
+    constant -log(eps) penalty with no gradient (the reference trains with
+    the gold forced into the beam, so this path only keeps mis-configured
+    beams finite).
+
+    Inputs: X [B,T] or [B,T,1] raw candidate scores, Ids [B,K] int selected
+    candidate positions (kmax_seq_score output), Label [B,1] int gold
+    position, optional Length [B] valid-candidate counts — when the beam
+    width exceeds a sequence's length, kmax pads with positions >= length;
+    those slots are excluded from the softmax.  Output: Out [B,1] loss.
+    Gradient flows into X through the gather + softmax (default vjp)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    if x.ndim == 3:
+        x = x[..., 0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    gold = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    sel = jnp.take_along_axis(x.astype(jnp.float32), ids, axis=1)  # [B,K]
+    valid = jnp.ones(ids.shape, bool)
+    if ins.get("Length") and ins["Length"][0] is not None:
+        lengths = ins["Length"][0].reshape(-1).astype(jnp.int32)
+        valid = ids < lengths[:, None]
+    sel = jnp.where(valid, sel, -jnp.inf)
+    logp = sel - jnp.max(sel, axis=1, keepdims=True)
+    logp = logp - jnp.log(
+        jnp.sum(jnp.where(valid, jnp.exp(logp), 0.0), axis=1, keepdims=True))
+    hit = (ids == gold[:, None]) & valid  # [B,K]
+    in_beam = jnp.any(hit, axis=1)
+    gold_logp = jnp.sum(jnp.where(hit, logp, 0.0), axis=1)
+    floor = jnp.log(jnp.asarray(1e-10, jnp.float32))
+    loss = jnp.where(in_beam, -gold_logp, -floor)
+    return {"Out": [loss.reshape(-1, 1)]}

@@ -32,6 +32,11 @@ def conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1))
+    if groups == -1:
+        # per-sample convolution (v1 ConvOperator): caller packed the batch
+        # into channels; one group per sample, resolved at trace time
+        ch = x.shape[3] if fmt == "NHWC" else x.shape[1]
+        groups = ch // w.shape[1]
     out = jax.lax.conv_general_dilated(
         x,
         w,

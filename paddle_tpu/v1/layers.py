@@ -79,6 +79,69 @@ class _RecurrentGroupCtx:
         self.batch_ref = batch_ref
         self.pending = {}  # layer name a memory remembers -> inner mem var
 
+    def make_memory(self, key, init, size):
+        return self.rnn.memory(init=init, shape=[int(size)],
+                               batch_ref=self.batch_ref)
+
+    def bind(self, name, var):
+        self.rnn.update_memory(self.pending.pop(name), var)
+
+
+def _expand_lanes(block, v, K, trailing):
+    """Beam-lane broadcast [B, *trailing] -> [B*K, *trailing] via the
+    beam_expand op, appended to an EXPLICIT block — beam_search uses it
+    both for its pre-loop StaticInputs (current block) and for memory boot
+    values, whose carried var must live in the block OUTSIDE the while.
+    `trailing` may contain dynamic (-1) dims, e.g. padded sequence T."""
+    from ..framework import unique_name
+
+    shape = tuple([-1] + [int(d) for d in trailing])
+    out = block.create_var(name=unique_name.generate("beam_exp"),
+                           shape=shape, dtype=v.dtype, stop_gradient=True)
+    block.append_op("beam_expand", inputs={"X": [v.name]},
+                    outputs={"Out": [out.name]},
+                    attrs={"beam_size": int(K)})
+    return out
+
+
+class _BeamGroupCtx:
+    """recurrent-group context in GENERATION mode (beam_search below):
+    memories become While-carried flat [B*K, size] vars created in the
+    OUTER block, re-gathered by beam parent pointers after every step
+    (the RecurrentGradientMachine.h:309 per-hypothesis state, as static
+    beam lanes)."""
+
+    def __init__(self, outer_block, flat_ref_name, beam_size):
+        self.outer = outer_block
+        self.flat_ref = flat_ref_name  # [B*K, 1] anchor var in outer block
+        self.K = int(beam_size)
+        self.pending = {}
+        self.mems = []   # (key, carried outer var, size)
+        self.bound = {}  # key -> this step's new value var (sub-block)
+
+    def make_memory(self, key, init, size):
+        if init is None:
+            from ..framework import unique_name
+            mem = self.outer.create_var(
+                name=unique_name.generate("beam_mem"),
+                shape=(-1, int(size)), dtype="float32", stop_gradient=True)
+            self.outer.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [self.flat_ref]},
+                outputs={"Out": [mem.name]},
+                attrs={"shape": [-1, int(size)], "value": 0.0,
+                       "dtype": "float32", "input_dim_idx": 0,
+                       "output_dim_idx": 0})
+        else:
+            # boot [B, size] -> [B*K, size] in the OUTER block
+            mem = _expand_lanes(self.outer, init, self.K, [int(size)])
+        self.mems.append((key, mem, int(size)))
+        return mem
+
+    def bind(self, name, var):
+        self.pending.pop(name, None)
+        self.bound[name] = var
+
 
 def _register_name(name, var):
     """v1 memories bind by layer NAME: `memory(name='s')` remembers the
@@ -86,7 +149,7 @@ def _register_name(name, var):
     config_parser Memory linkage).  Every wrapper that accepts name= routes
     through here so building that layer closes the recurrence."""
     if _rgroup is not None and name in _rgroup.pending:
-        _rgroup.rnn.update_memory(_rgroup.pending.pop(name), var)
+        _rgroup.bind(name, var)
 
 
 def _apply_act(var, act):
@@ -95,6 +158,68 @@ def _apply_act(var, act):
         return var
     helper = LayerHelper("activation", act=a)
     return helper.append_activation(var)
+
+
+# --- v1 constants / decorators (reference layers.py:  AggregateLevel:138,
+# ExpandLevel:  ~1520, LayerType:208, layer_support:313) ---------------------
+
+class AggregateLevel:
+    """Sequence-aggregation level for pooling/concat layers."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # deprecated reference spellings kept for config compatibility
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """Expansion level for expand_layer."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class LayerType:
+    """Layer-type name constants (reference layers.py LayerType:208).  The
+    reference validates each name against config_parser; here the names
+    document the v1 surface and `is_layer_type` keeps the API contract."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    CONV_LAYER = "conv"
+    CONVTRANS_LAYER = "convt"
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    RECURRENT_LAYER_GROUP = "recurrent_layer_group"
+    SEQUENCE_LAST_INSTANCE = "last_seq"
+    SEQUENCE_FIRST_INSTANCE = "first_seq"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    CONCAT_LAYER = "concat"
+    MIXED_LAYER = "mixed"
+    COST = "cost"
+    CTC_LAYER = "ctc"
+    CRF_LAYER = "crf"
+    MAXID_LAYER = "max_id"
+    EOSID_LAYER = "eos_id"
+    MEMORY = "memory"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str) and bool(type_name)
+
+
+def layer_support(*attrs):
+    """Decorator marking which ExtraLayerAttribute features a layer supports
+    (reference layers.py layer_support:313).  Device placement/dropout
+    attrs are Program-level concerns here, so this only preserves the
+    decoration contract."""
+    def decorator(fn):
+        return fn
+    if len(attrs) == 1 and callable(attrs[0]):
+        return attrs[0]
+    return decorator
 
 
 # --- data --------------------------------------------------------------------
@@ -320,6 +445,143 @@ def dotmul_projection(input, param_attr=None):
     return _Projection(fn, size_hint=getattr(input, "size", None))
 
 
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    """TransposedFullMatrixProjection (reference layers.py
+    trans_full_matrix_projection:735): out = x @ W^T, sharing the [size,
+    in_dim]-shaped weight so an fc elsewhere can reuse it transposed."""
+    def fn(target_size):
+        helper = LayerHelper("trans_fc", param_attr=to_param_attr(param_attr))
+        iv = _var(input)
+        w = helper.create_parameter(
+            attr=to_param_attr(param_attr) or {},
+            shape=[int(target_size), int(iv.shape[-1])], dtype=iv.dtype)
+        out = helper.create_tmp_variable(
+            iv.dtype, shape=tuple(iv.shape[:-1]) + (int(target_size),))
+        helper.append_op("matmul",
+                         inputs={"X": [iv.name], "Y": [w.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"transpose_X": False, "transpose_Y": True})
+        return out
+    return _Projection(fn, size_hint=size)
+
+
+def scaling_projection(input, param_attr=None):
+    """ScalingProjection (reference layers.py scaling_projection:649):
+    out = w * in with a single trainable scalar."""
+    def fn(target_size):
+        helper = LayerHelper("scaling_proj",
+                             param_attr=to_param_attr(param_attr))
+        iv = _var(input)
+        w = helper.create_parameter(attr=to_param_attr(param_attr) or {},
+                                    shape=[1], dtype=iv.dtype)
+        return fl.elementwise_mul(iv, w)
+    return _Projection(fn, size_hint=getattr(input, "size", None))
+
+
+def slice_projection(input, slices):
+    """SliceProjection (reference layers.py slice_projection:680): select
+    and concatenate [start, end) feature slices; no trainable parameter."""
+    start = 0
+    for s, e in slices:
+        if not (s >= start and e >= s):
+            raise ValueError(f"slice_projection: slices must be ordered and "
+                             f"non-overlapping, got {slices}")
+        start = e
+    width = sum(e - s for s, e in slices)
+
+    def fn(target_size):
+        helper = LayerHelper("slice_proj")
+        iv = _var(input)
+        parts = []
+        for s, e in slices:
+            p = helper.create_tmp_variable(
+                iv.dtype, shape=tuple(iv.shape[:-1]) + (e - s,))
+            helper.append_op("slice", inputs={"Input": [iv.name]},
+                             outputs={"Out": [p.name]},
+                             attrs={"axes": [len(iv.shape) - 1],
+                                    "starts": [int(s)], "ends": [int(e)]})
+            parts.append(p)
+        return parts[0] if len(parts) == 1 else fl.concat(parts, axis=-1)
+    return _Projection(fn, size_hint=width)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, filter_size_y=None, stride_y=None,
+                    padding_y=None, groups=1, param_attr=None, trans=False):
+    """ConvProjection / ConvTransProjection (reference layers.py
+    conv_projection:772): a conv with its own filter parameter usable inside
+    mixed_layer; spatial attrs mirror img_conv_layer."""
+    ky = filter_size_y if filter_size_y is not None else filter_size
+    sy = stride_y if stride_y is not None else stride
+    py = padding_y if padding_y is not None else padding
+
+    def fn(target_size):
+        iv = _var(input)
+        out = img_conv_layer(
+            input if isinstance(input, LayerOutput) else _wrap(iv, "in"),
+            filter_size=[int(ky), int(filter_size)],
+            num_filters=num_filters, num_channels=num_channels,
+            stride=[int(sy), int(stride)], padding=[int(py), int(padding)],
+            groups=groups, param_attr=param_attr, bias_attr=False,
+            trans=trans)
+        return fl.reshape(_var(out), [0, -1])
+    return _Projection(fn, size_hint=None)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None, trans=False):
+    """ConvOperator (reference layers.py conv_operator:1444;
+    gserver ConvOperator): PER-SAMPLE convolution whose filter comes from
+    another layer's output (operators own no parameters).  Lowered as one
+    grouped conv with batch-as-groups — img [B,C,H,W] packs to
+    [1,B*C,H,W], filters to [B*F,C,kh,kw], feature_group_count=B — so the
+    dynamic-filter conv still runs as a single MXU convolution."""
+    if trans:
+        raise NotImplementedError(
+            "conv_operator(trans=True) (ConvTransOperator): per-sample "
+            "TRANSPOSED convolution is not lowered yet; use "
+            "conv_projection(trans=True) for the parameterized form")
+    ky = int(filter_size_y if filter_size_y is not None else filter_size)
+    kx = int(filter_size)
+    sy = int(stride_y if stride_y is not None else stride)
+    sx = int(stride)
+    py = int(padding_y if padding_y is not None else padding)
+    px = int(padding)
+
+    def fn(target_size):
+        helper = LayerHelper("conv_op")
+        iv, fv = _var(img), _var(filter)
+        C = int(num_channels) if num_channels is not None else int(iv.shape[1])
+        H, W = int(iv.shape[2]), int(iv.shape[3])
+        F = int(num_filters)
+        x2 = fl.reshape(_var(img), [1, -1, H, W])
+        w = fl.reshape(fv, [-1, C, ky, kx])
+        out = helper.create_tmp_variable(iv.dtype, shape=None)
+        helper.append_op(
+            "conv2d", inputs={"Input": [x2.name], "Filter": [w.name]},
+            outputs={"Output": [out.name]},
+            attrs={"strides": [sy, sx], "paddings": [py, px], "groups": -1})
+        oh = (H + 2 * py - ky) // sy + 1
+        ow = (W + 2 * px - kx) // sx + 1
+        return fl.reshape(out, [-1, F * oh * ow])
+    return _Projection(fn, size_hint=None)
+
+
+def dotmul_operator(a=None, b=None, scale=1, **kwargs):
+    """DotMulOperator (reference layers.py dotmul_operator:609):
+    out += scale * (a .* b); parameterless."""
+    a = a or kwargs.get("x")
+    b = b or kwargs.get("y")
+
+    def fn(target_size):
+        out = fl.elementwise_mul(_var(a), _var(b))
+        if scale != 1:
+            out = fl.scale(out, scale=float(scale))
+        return out
+    return _Projection(fn, size_hint=getattr(a, "size", None))
+
+
 class MixedLayerType:
     """`with mixed_layer(size=...) as m: m += projection` form (reference
     layers.py MixedLayerType:823/842 — __iadd__ collects projections, exit
@@ -367,7 +629,8 @@ def mixed_layer(size=0, input=None, act=None, bias_attr=None, name=None):
         v = p.fn(size or p.size_hint)
         acc = v if acc is None else fl.elementwise_add(acc, v)
     acc = _apply_act(acc, act)
-    return _wrap(acc, "mixed", size=size or projs[0].size_hint, name=name)
+    return _wrap(acc, "mixed", size=size or projs[0].size_hint, name=name,
+                 act=act_name(act))
 
 
 # --- sequence layers ---------------------------------------------------------
@@ -601,6 +864,41 @@ def multi_binary_label_cross_entropy(input, label, name=None):
 cross_entropy = cross_entropy_cost  # reference name (layers.py:6073)
 
 
+class BeamInput:
+    """One beam expansion for cross_entropy_over_beam (reference layers.py
+    BeamInput:5774): candidate scores, the kmax-selected candidates, and
+    the gold candidate index."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None):
+    """CrossEntropyOverBeamLayer (reference layers.py
+    cross_entropy_over_beam:5804; gserver/layers/CrossEntropyOverBeam.cpp):
+    sum of per-expansion cross-entropies over the beam search path."""
+    beams = input if isinstance(input, (list, tuple)) else [input]
+    helper = LayerHelper("cross_entropy_over_beam")
+    total = None
+    for b in beams:
+        sv = _var(b.candidate_scores)
+        cv = _var(b.selected_candidates)
+        gv = _var(b.gold)
+        inputs = {"X": [sv.name], "Ids": [cv.name], "Label": [gv.name]}
+        lv = get_length_var(sv)
+        if lv is not None:  # beams wider than a short sequence: mask pads
+            inputs["Length"] = [lv.name]
+        out = helper.create_tmp_variable(sv.dtype, shape=None)
+        helper.append_op(
+            "cross_entropy_over_beam", inputs=inputs,
+            outputs={"Out": [out.name]})
+        total = out if total is None else fl.elementwise_add(total, out)
+    return _wrap(fl.mean(total), "cost", size=1,
+                 parents=[b.candidate_scores for b in beams], name=name)
+
+
 def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
                                 softmax_selfnorm_alpha=0.1, layer_attr=None):
     """CrossEntropyWithSelfNorm (reference layers.py:6120)."""
@@ -779,7 +1077,12 @@ def parse_network(*outputs_) -> Program:
     """The config_parser.parse_config equivalent: v1 configs built these
     functions into a ModelConfig proto (config_parser.py:4345); here the
     Program *is* the config — return it (serializable via
-    framework.proto_io)."""
+    framework.proto_io).  A single callable argument is the reference's
+    non-file-config form (tests/configs/test_config_parser_for_non_file_
+    config.py): invoke it to build the net, then return the Program."""
+    if len(outputs_) == 1 and callable(outputs_[0]) \
+            and not isinstance(outputs_[0], (LayerOutput, Variable)):
+        outputs_[0]()
     return default_main_program()
 
 
@@ -1067,6 +1370,9 @@ def printer_layer(input, format=None, name=None):
         outs.append(out)
     return _wrap(outs[0], "print", size=getattr(ins[0], "size", None),
                  parents=list(ins), name=name)
+
+
+print_layer = printer_layer  # reference alias (layers.py print_layer)
 
 
 # --- image stack additions ---------------------------------------------------
@@ -1559,6 +1865,23 @@ def SubsequenceInput(input):
     return input
 
 
+class BaseGeneratedInput:
+    """Marker base for generation-driven recurrent-group inputs (reference
+    layers.py BaseGeneratedInput:3986)."""
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """Embedding of the previously generated token (reference layers.py
+    GeneratedInput:4009): in beam_search, each step's selected words feed
+    back through the shared `embedding_name` table of shape
+    [size, embedding_size]."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        self.size = int(size)
+        self.embedding_name = embedding_name
+        self.embedding_size = int(embedding_size)
+
+
 def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
            boot_bias=None, boot_bias_active_type=None,
            boot_with_const_id=None):
@@ -1571,8 +1894,7 @@ def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
                            "step function (RecurrentLayerGroup semantics)")
     key = name or memory_name
     init = _var(boot_layer) if boot_layer is not None else None
-    mem_var = _rgroup.rnn.memory(init=init, shape=[int(size)],
-                                 batch_ref=_rgroup.batch_ref)
+    mem_var = _rgroup.make_memory(key, init, int(size))
     _rgroup.pending[key] = mem_var
     lo = _wrap(mem_var, "memory", size=size)
 
@@ -1645,6 +1967,174 @@ def get_output_layer(input, arg_name, name=None, layer_attr=None):
     if name is not None:
         _register_name(name, _var(aux))
     return aux
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None, num_results_per_sample=None):
+    """Generation-mode recurrent_group (reference layers.py beam_search:4465;
+    RecurrentGradientMachine::generateSequence/beamSearch :307/:309).
+
+    TPU-native redesign: instead of the reference's dynamic per-hypothesis
+    scopes, the user's `step` function is traced ONCE into a While body over
+    a beam-flattened batch [B*K, ...]; `memory()` calls become loop-carried
+    vars gathered by parent pointers after each composable `beam_search` op
+    step (ops/beam_ops.py), and `beam_search_decode` backtracks the
+    hypotheses — the whole search compiles into one XLA while program.
+
+    Returns a LayerOutput over the generated ids [B, K, L], with auxiliary
+    outputs 'scores' [B, K] and 'lengths' [B, K] reachable via
+    get_output_layer (v2's SequenceGenerator consumes exactly these)."""
+    global _rgroup
+
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gens = [i for i in inputs if isinstance(i, BaseGeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput "
+                         "(the previously generated word feed)")
+    gi = gens[0]
+    statics = [i for i in inputs if isinstance(i, StaticInput)]
+    if not statics:
+        raise ValueError("beam_search needs at least one StaticInput (it "
+                         "anchors the batch size at generation time)")
+    stray = [i for i in inputs
+             if not isinstance(i, (StaticInput, BaseGeneratedInput))]
+    if stray:
+        raise ValueError(
+            f"beam_search inputs must be StaticInput or GeneratedInput "
+            f"(reference layers.py beam_search:4465 'none of the input's "
+            f"type should be LayerOutput'); got {stray}")
+    K, L = int(beam_size), int(max_length)
+    helper = LayerHelper("beam_search_group", name=name)
+    program = default_main_program()
+    ref = _var(statics[0].input)  # [B, ...]
+
+    def batch_like(shape, value, dtype, out_idx=0):
+        out = helper.create_tmp_variable(dtype, shape=tuple(shape),
+                                         stop_gradient=True)
+        helper.append_op(
+            "fill_constant_batch_size_like",
+            inputs={"Input": [ref.name]}, outputs={"Out": [out.name]},
+            attrs={"shape": list(shape), "value": float(value),
+                   "dtype": dtype, "input_dim_idx": 0,
+                   "output_dim_idx": out_idx})
+        return out
+
+    def expand_beam(v, trailing):
+        """[B, ...] -> [B*K, ...] (every hypothesis sees its sample's data)."""
+        return _expand_lanes(program.current_block(), v, K, trailing)
+
+    # --- pre-loop beam state -------------------------------------------------
+    tokens = batch_like([-1, K], float(bos_id), "int64")
+    # lane 0 live, the rest dead: K identical <bos> hypotheses would waste
+    # the whole beam on copies
+    lane = helper.create_tmp_variable("float32", shape=(1, K),
+                                      stop_gradient=True)
+    helper.append_op("assign_value", inputs={}, outputs={"Out": [lane.name]},
+                     attrs={"shape": [1, K],
+                            "fp32_values": [0.0] + [-1e9] * (K - 1)})
+    scores = fl.elementwise_add(batch_like([-1, K], 0.0, "float32"), lane)
+    ids_arr = batch_like([L, -1, K], 0.0, "int64", out_idx=1)
+    par_arr = batch_like([L, -1, K], 0.0, "int32", out_idx=1)
+    flat_ref = fl.reshape(tokens, [-1, 1])  # [B*K, 1] batch anchor
+
+    expanded = {}
+    for s in statics:
+        v = _var(s.input)
+        trailing = [int(d) for d in v.shape[1:]]
+        ev = expand_beam(v, trailing)
+        lv = get_length_var(v)
+        if lv is not None:  # is_seq static input: replicate lengths too
+            elv = expand_beam(lv, [])
+            from ..layers.sequence import _set_length
+            _set_length(ev, elv.name)
+        expanded[id(s)] = _wrap(ev, "beam_static",
+                                size=getattr(s.input, "size", None))
+
+    t = fl.fill_constant(shape=[1], dtype="float32", value=0.0)
+    n = fl.fill_constant(shape=[1], dtype="float32", value=float(L))
+    ti = fl.fill_constant(shape=[1], dtype="int32", value=0)
+    cond = fl.less_than(t, n)
+    w = fl.While(cond)
+    ctx = _BeamGroupCtx(program.current_block(), flat_ref.name, K)
+    prev = _rgroup
+    with w.block():
+        try:
+            _rgroup = ctx
+            tok_flat = fl.reshape(tokens, [-1, 1])
+            emb = fl.embedding(tok_flat, size=[gi.size, gi.embedding_size],
+                               param_attr={"name": gi.embedding_name})
+            args = []
+            for i in inputs:
+                if isinstance(i, BaseGeneratedInput):
+                    args.append(_wrap(emb, "generated_input",
+                                      size=gi.embedding_size))
+                else:
+                    args.append(expanded[id(i)])
+            out = step(*args)
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            if ctx.pending:
+                missing = ", ".join(ctx.pending)
+                raise RuntimeError(
+                    f"beam_search: memories for [{missing}] were never "
+                    f"bound — build a layer with that name inside step()")
+        finally:
+            _rgroup = prev
+        V = int(out.size or gi.size)
+        ov = fl.reshape(_var(out), [-1, V])
+        if _needs_softmax(out):  # v1 step outputs are softmax-activated;
+            ov = fl.softmax(ov)  # normalize the ones that aren't
+        clipped = helper.create_tmp_variable(ov.dtype, shape=None,
+                                             stop_gradient=True)
+        helper.append_op("clip", inputs={"X": [ov.name]},
+                         outputs={"Out": [clipped.name]},
+                         attrs={"min": 1e-12, "max": 1.0})
+        logp = helper.create_tmp_variable(ov.dtype, shape=None,
+                                          stop_gradient=True)
+        helper.append_op("log", inputs={"X": [clipped.name]},
+                         outputs={"Out": [logp.name]})
+        logp.shape = (-1, V)  # topk reads the static last dim
+        cand_scores, cand_ids = fl.topk(logp, K)  # [B*K, K]
+        sel_ids, sel_scores, parent = fl.beam_search(
+            tokens, scores, fl.reshape(cand_ids, [-1, K, K]),
+            fl.reshape(cand_scores, [-1, K, K]),
+            beam_size=K, end_id=int(eos_id), is_accumulated=False)
+        # re-lane every memory behind its surviving parent hypothesis
+        for key, mem, size in ctx.mems:
+            new = ctx.bound.get(key)
+            if new is None:
+                raise RuntimeError(f"beam_search: memory {key!r} has no "
+                                   f"updated value")
+            g = helper.create_tmp_variable(mem.dtype, shape=None,
+                                           stop_gradient=True)
+            helper.append_op(
+                "beam_gather",
+                inputs={"X": [fl.reshape(new, [-1, K, size]).name],
+                        "Index": [parent.name]},
+                outputs={"Out": [g.name]})
+            fl.assign(fl.reshape(g, [-1, size]), mem)
+        for arr, val, dt in ((ids_arr, sel_ids, "int64"),
+                             (par_arr, parent, "int32")):
+            wrote = helper.create_tmp_variable(dt, shape=None,
+                                               stop_gradient=True)
+            helper.append_op("array_write",
+                             inputs={"Array": [arr.name], "X": [val.name],
+                                     "I": [ti.name]},
+                             outputs={"Out": [wrote.name]})
+            fl.assign(wrote, arr)
+        fl.assign(sel_ids, tokens)
+        fl.assign(sel_scores, scores)
+        fl.increment(t, 1.0)
+        fl.increment(ti, 1)
+        fl.less_than(t, n, cond=cond)
+
+    sent, sscores, slen = fl.beam_search_decode(ids_arr, par_arr, scores,
+                                                end_id=int(eos_id))
+    res = _wrap(sent, "beam_search", size=gi.size, name=name)
+    res.outputs["scores"] = _wrap(sscores, "beam_scores", size=K)
+    res.outputs["lengths"] = _wrap(slen, "beam_lengths", size=K)
+    res.num_results_per_sample = (int(num_results_per_sample)
+                                  if num_results_per_sample else K)
+    return res
 
 
 def lstm_step_layer(input, state, size=None, act=None, name=None,

@@ -452,6 +452,37 @@ def cfg_test_lambda_cost():
     return v1.lambda_cost(s, sc, NDCG_num=3), {"lambda_rank"}
 
 
+def cfg_test_cross_entropy_over_beam():
+    # mirrors the reference's three-expansion beam QA config: kmax beam
+    # expansions scored by fc, cost summed over beams
+    scores = _seq("ceob_scores", 1)
+    topk = v1.kmax_seq_score_layer(scores, beam_size=3)
+    gold = v1.data_layer("ceob_gold", size=1, dtype="int64")
+    feats = _seq("ceob_feats", 4)
+    s2 = v1.fc_layer(feats, size=1, act=None)
+    topk2 = v1.kmax_seq_score_layer(s2, beam_size=3)
+    gold2 = v1.data_layer("ceob_gold2", size=1, dtype="int64")
+    cost = v1.cross_entropy_over_beam([
+        v1.BeamInput(candidate_scores=scores, selected_candidates=topk,
+                     gold=gold),
+        v1.BeamInput(candidate_scores=s2, selected_candidates=topk2,
+                     gold=gold2)])
+    return cost, {"kmax_seq_score", "cross_entropy_over_beam"}
+
+
+def cfg_test_config_parser_for_non_file_config():
+    # the reference feeds a config FUNCTION (not a file) through
+    # parse_config; parse_network accepts the same callable form
+    holder = {}
+
+    def configs():
+        x = v1.data_layer("nfc_x", size=4)
+        holder["out"] = v1.fc_layer(x, size=2, act=SoftmaxActivation())
+
+    prog = v1.parse_network(configs)
+    return holder["out"], {"softmax"}
+
+
 CONFIGS = [v for k, v in sorted(globals().items()) if k.startswith("cfg_")]
 
 

@@ -376,3 +376,166 @@ def test_printer_layer_passthrough():
     p = v1.printer_layer(x)
     (o,) = _run({"prx": np.ones((1, 2), np.float32)}, [p.var])
     np.testing.assert_allclose(o, [[1.0, 1.0]])
+
+
+# --- round-2 continuation: projections/operators, enums, beam machinery -----
+
+def test_new_projections_and_operators_in_mixed():
+    x = v1.data_layer("pmx", size=4)
+    y = v1.data_layer("pmy", size=4)
+    with v1.mixed_layer(size=4) as m:
+        m += v1.trans_full_matrix_projection(x, size=4)
+        m += v1.scaling_projection(x)
+        m += v1.slice_projection(x, slices=[(0, 2), (2, 4)])
+        m += v1.dotmul_operator(a=x, b=y, scale=2.0)
+    xv = np.ones((2, 4), np.float32)
+    (out,) = _run({"pmx": xv, "pmy": xv * 3.0}, [m.var])
+    assert out.shape == (2, 4)
+    # parameterless pieces alone: slice = identity here, dotmul = 6
+    prog_ops = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert "matmul" in prog_ops and "slice" in prog_ops
+
+
+def test_conv_projection_and_operator():
+    img = v1.data_layer("cpi", size=1 * 4 * 4, height=4, width=4)
+    with v1.mixed_layer() as m:
+        m += v1.conv_projection(img, filter_size=3, num_filters=2, padding=1)
+    # conv_operator: filter supplied by another layer's output
+    filt = v1.data_layer("cpf", size=2 * 1 * 3 * 3)
+    with v1.mixed_layer() as m2:
+        m2 += v1.conv_operator(img=img, filter=filt, filter_size=3,
+                               num_filters=2, num_channels=1, padding=1)
+    x = np.random.RandomState(0).rand(2, 1, 4, 4).astype(np.float32)
+    f = np.random.RandomState(1).rand(2, 18).astype(np.float32)
+    o1, o2 = _run({"cpi": x, "cpf": f}, [m.var, m2.var])
+    assert o1.shape == (2, 32) and o2.shape == (2, 32)
+
+
+def test_v1_enums_and_decorators():
+    assert v1.AggregateLevel.TO_NO_SEQUENCE == "non-seq"
+    assert v1.ExpandLevel.FROM_SEQUENCE == v1.AggregateLevel.TO_SEQUENCE
+    assert v1.LayerType.is_layer_type("fc")
+    assert v1.print_layer is v1.printer_layer
+
+    @v1.layer_support("drop_rate")
+    def f(x):
+        return x
+    assert f(3) == 3
+
+
+def test_cross_entropy_over_beam_trains():
+    scores = v1.data_layer("beam_scores", size=1, seq=True)
+    topk = v1.kmax_seq_score_layer(scores, beam_size=3)
+    gold = v1.data_layer("beam_gold", size=1, dtype="int64")
+    cost = v1.cross_entropy_over_beam(
+        [v1.BeamInput(candidate_scores=scores, selected_candidates=topk,
+                      gold=gold)])
+    # score sequences: candidate 2 should win for row 0; gold = 2 (in beam)
+    lt = LoDTensor.from_sequences(
+        [np.array([[0.1], [0.2], [0.9], [0.05]], np.float32),
+         np.array([[0.5], [0.4]], np.float32)])
+    g = np.array([[2], [0]], np.int64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (loss,) = exe.run(feed={"beam_scores": lt, "beam_gold": g},
+                      fetch_list=[cost.var])
+    loss = float(np.asarray(loss).reshape(()))
+    assert np.isfinite(loss) and loss > 0.0
+
+
+def test_v1_beam_search_generates():
+    rng = np.random.RandomState(7)
+    V, H, B, K, L = 7, 8, 2, 3, 5
+    enc = v1.data_layer("bs_enc", size=H)
+
+    def rnn_step(static_enc, cur_word):
+        prev = v1.memory(name="bs_dec", size=H)
+        hid = v1.fc_layer([static_enc, cur_word, prev], size=H,
+                          act=v1.TanhActivation() if hasattr(v1, "TanhActivation")
+                          else None, name="bs_dec")
+        return v1.fc_layer(hid, size=V, act=SoftmaxActivation())
+
+    from paddle_tpu.v1.activations import SoftmaxActivation
+    gen_in = v1.GeneratedInput(size=V, embedding_name="bs_emb",
+                               embedding_size=4)
+    out = v1.beam_search(step=rnn_step,
+                         input=[v1.StaticInput(enc), gen_in],
+                         bos_id=0, eos_id=1, beam_size=K, max_length=L)
+    scores = v1.get_output_layer(out, "scores")
+    lengths = v1.get_output_layer(out, "lengths")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ids, sc, ln = exe.run(
+        feed={"bs_enc": rng.rand(B, H).astype(np.float32)},
+        fetch_list=[out.var, scores.var, lengths.var])
+    ids, sc, ln = np.asarray(ids), np.asarray(sc), np.asarray(ln)
+    assert ids.shape == (B, K, L) and sc.shape == (B, K) and ln.shape == (B, K)
+    assert ids.min() >= 0 and ids.max() < V
+    # scores best-first per row after ranking by the generator contract
+    assert np.all(np.isfinite(sc[:, 0]))
+    # v2 SequenceGenerator consumes these directly
+    from paddle_tpu.v2.inference import SequenceGenerator
+    gen = SequenceGenerator(out.var, scores.var, lengths.var,
+                            eos_id=1, place=fluid.CPUPlace())
+    res = gen({"bs_enc": rng.rand(B, H).astype(np.float32)})
+    assert len(res) == B and all(len(r) <= K for r in res)
+
+
+def test_cross_entropy_over_beam_masks_padded_candidates():
+    # beam wider than one row's sequence: kmax clamps k to min(k, T) over
+    # the PADDED batch, so only a multi-sequence batch of unequal lengths
+    # (T=4, k=3, short row length 2) produces padded candidate slots —
+    # those must not enter the softmax (round-2 review finding)
+    scores = v1.data_layer("beam_ms", size=1, seq=True)
+    topk = v1.kmax_seq_score_layer(scores, beam_size=3)
+    gold = v1.data_layer("beam_mg", size=1, dtype="int64")
+    cost = v1.cross_entropy_over_beam(
+        v1.BeamInput(candidate_scores=scores, selected_candidates=topk,
+                     gold=gold))
+    lt = LoDTensor.from_sequences(
+        [np.array([[0.5], [0.1], [3.0], [0.2]], np.float32),  # length 4
+         np.array([[2.0], [1.0]], np.float32)])               # length 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (loss,) = exe.run(feed={"beam_ms": lt,
+                            "beam_mg": np.array([[2], [0]], np.int64)},
+                      fetch_list=[cost.var])
+    import math
+    # row 0: softmax over its top-3 {3.0, 0.5, 0.2}, gold 3.0
+    e = math.exp
+    l0 = -math.log(e(3.0) / (e(3.0) + e(0.5) + e(0.2)))
+    # row 1: only 2 real candidates {2.0, 1.0} — the third slot is padding
+    # and MUST be excluded; gold 2.0
+    l1 = -math.log(e(2.0) / (e(2.0) + e(1.0)))
+    np.testing.assert_allclose(float(np.asarray(loss).reshape(())),
+                               (l0 + l1) / 2.0, rtol=1e-4)
+
+
+def test_v1_beam_search_with_sequence_static_input():
+    # attention-style generation: the encoder output is an is_seq
+    # StaticInput [B,T,H] whose lanes (and lengths) must beam-expand
+    rng = np.random.RandomState(3)
+    V, H, B, T, K, L = 6, 5, 2, 4, 3, 4
+    enc = v1.data_layer("bse_enc", size=H, seq=True)
+
+    def step(static_enc, cur_word):
+        # pool the encoder sequence each step + previous state
+        ctx = v1.pooling_layer(static_enc)
+        prev = v1.memory(name="bse_dec", size=H)
+        hid = v1.fc_layer([ctx, cur_word, prev], size=H, name="bse_dec")
+        from paddle_tpu.v1.activations import SoftmaxActivation
+        return v1.fc_layer(hid, size=V, act=SoftmaxActivation())
+
+    out = v1.beam_search(
+        step=step,
+        input=[v1.StaticInput(enc, is_seq=True),
+               v1.GeneratedInput(size=V, embedding_name="bse_emb",
+                                 embedding_size=4)],
+        bos_id=0, eos_id=1, beam_size=K, max_length=L)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lt = LoDTensor.from_sequences(
+        [rng.rand(T, H).astype(np.float32),
+         rng.rand(2, H).astype(np.float32)])
+    (ids,) = exe.run(feed={"bse_enc": lt}, fetch_list=[out.var])
+    assert np.asarray(ids).shape == (B, K, L)

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+V5E_HBM_BPS = 819e9  # TPU v5e HBM bandwidth, bytes/s (public spec)
 
 
 def main():
@@ -68,22 +69,6 @@ def main():
     # pick the train-step entry (the other cache entry is the startup program)
     compiled = next(c for _, c in exe._cache.values()
                     if avg_cost.name in c.fetch_names)
-    cost = {}
-    try:
-        if args.no_cost:
-            raise RuntimeError("--no-cost")
-        # AOT-lower a fresh copy for cost analysis (cheap: cache-hit on trace)
-        state_w = {n: fluid.global_scope().find(n) for n in compiled.rw_state}
-        state_r = {n: fluid.global_scope().find(n)
-                   for n in compiled.external_reads}
-        rngk = jax.random.PRNGKey(0)
-        lowered = compiled.fn.lower(state_w, state_r, feed, rngk)
-        cost = lowered.compile().cost_analysis() or {}
-        if isinstance(cost, list):
-            cost = cost[0]
-    except Exception as e:  # cost analysis is best-effort on tunneled PJRT
-        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
-
     if args.trace:
         jax.profiler.start_trace(args.trace)
     t0 = time.perf_counter()
@@ -95,6 +80,23 @@ def main():
     if args.trace:
         jax.profiler.stop_trace()
 
+    # cost analysis AFTER timing: the AOT-compiled duplicate executable
+    # occupies HBM and would slow the measured loop by ~2.5x
+    cost = {}
+    try:
+        if args.no_cost:
+            raise RuntimeError("--no-cost")
+        state_w = {n: fluid.global_scope().find(n) for n in compiled.rw_state}
+        state_r = {n: fluid.global_scope().find(n)
+                   for n in compiled.external_reads}
+        rngk = jax.random.PRNGKey(0)
+        lowered = compiled.fn.lower(state_w, state_r, feed, rngk)
+        cost = lowered.compile().cost_analysis() or {}
+        if isinstance(cost, list):
+            cost = cost[0]
+    except Exception as e:  # cost analysis is best-effort on tunneled PJRT
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+
     img_s = args.bs / dt
     flops = float(cost.get("flops", 0.0))
     print(f"step time        : {dt*1e3:.2f} ms")
@@ -104,9 +106,20 @@ def main():
               f"({flops/args.bs/1e9:.2f} GFLOP/img)")
         print(f"achieved         : {flops/dt/1e12:.1f} TFLOP/s")
         print(f"MFU (v5e bf16)   : {100*flops/dt/V5E_PEAK_BF16:.1f}%")
-    for k in sorted(cost):
-        if "bytes" in k or "time" in k:
-            print(f"  {k}: {cost[k]:.3e}")
+    gb = float(cost.get("bytes accessed", 0.0))
+    if gb and flops:
+        # roofline verdict (docs/perf_resnet50_roofline.md): which roof is
+        # binding, and how close the measured step runs to it
+        t_mem = gb / V5E_HBM_BPS
+        t_flop = flops / V5E_PEAK_BF16
+        bound = "HBM-bandwidth" if t_mem > t_flop else "compute"
+        roof = max(t_mem, t_flop)
+        print(f"bytes accessed   : {gb/1e9:.1f} GB/step")
+        print(f"roofline         : mem {t_mem*1e3:.1f} ms vs "
+              f"flop {t_flop*1e3:.1f} ms -> {bound}-bound; measured "
+              f"{dt*1e3:.1f} ms = {100*roof/dt:.0f}% of the binding roof")
+        print(f"arith intensity  : {flops/gb:.0f} FLOP/byte "
+              f"(v5e balance {V5E_PEAK_BF16/V5E_HBM_BPS:.0f})")
 
 
 if __name__ == "__main__":
