@@ -450,6 +450,10 @@ def trans_full_matrix_projection(input, size=0, param_attr=None):
     trans_full_matrix_projection:735): out = x @ W^T, sharing the [size,
     in_dim]-shaped weight so an fc elsewhere can reuse it transposed."""
     def fn(target_size):
+        if not target_size:
+            raise ValueError(
+                "trans_full_matrix_projection needs a resolvable size: pass "
+                "size= to the projection or to the enclosing mixed_layer")
         helper = LayerHelper("trans_fc", param_attr=to_param_attr(param_attr))
         iv = _var(input)
         w = helper.create_parameter(
@@ -2129,11 +2133,24 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
 
     sent, sscores, slen = fl.beam_search_decode(ids_arr, par_arr, scores,
                                                 end_id=int(eos_id))
+    nres = int(num_results_per_sample) if num_results_per_sample else K
+    if nres < K:
+        # beam lanes are score-sorted (each beam_search step is a top-k),
+        # so the best n hypotheses are the first n lanes
+        def lane_slice(v):
+            out = helper.create_tmp_variable(v.dtype, shape=None,
+                                             stop_gradient=True)
+            helper.append_op("slice", inputs={"Input": [v.name]},
+                             outputs={"Out": [out.name]},
+                             attrs={"axes": [1], "starts": [0],
+                                    "ends": [nres]})
+            return out
+        sent, sscores, slen = (lane_slice(sent), lane_slice(sscores),
+                               lane_slice(slen))
     res = _wrap(sent, "beam_search", size=gi.size, name=name)
-    res.outputs["scores"] = _wrap(sscores, "beam_scores", size=K)
-    res.outputs["lengths"] = _wrap(slen, "beam_lengths", size=K)
-    res.num_results_per_sample = (int(num_results_per_sample)
-                                  if num_results_per_sample else K)
+    res.outputs["scores"] = _wrap(sscores, "beam_scores", size=nres)
+    res.outputs["lengths"] = _wrap(slen, "beam_lengths", size=nres)
+    res.num_results_per_sample = nres
     return res
 
 

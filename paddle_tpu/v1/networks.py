@@ -132,9 +132,11 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
     wexp = helper.create_tmp_variable(scores.dtype, shape=None)
     helper.append_op("unsqueeze", inputs={"X": [weights.name]},
                      outputs={"Out": [wexp.name]}, attrs={"axes": [-1]})
-    # context = sum_t w_t * enc_t
+    # context = sum_t w_t * enc_t  (static feature dim so downstream fc
+    # layers can size their weights)
     weighted = fl.elementwise_mul(enc, wexp)
-    ctx = helper.create_tmp_variable(enc.dtype, shape=None)
+    ctx = helper.create_tmp_variable(enc.dtype,
+                                     shape=(-1, int(enc.shape[-1])))
     helper.append_op("reduce_sum", inputs={"X": [weighted.name]},
                      outputs={"Out": [ctx.name]},
                      attrs={"dim": 1, "keep_dim": False})

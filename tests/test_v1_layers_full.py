@@ -539,3 +539,82 @@ def test_v1_beam_search_with_sequence_static_input():
          rng.rand(2, H).astype(np.float32)])
     (ids,) = exe.run(feed={"bse_enc": lt}, fetch_list=[out.var])
     assert np.asarray(ids).shape == (B, K, L)
+
+
+def test_v1_nmt_attention_generation():
+    """The reference demo/seqToseq gen.conf pattern: GRU decoder with
+    simple_attention over the encoded source, generating via beam_search
+    (RecurrentGradientMachine generation mode) — the flagship v1 use case."""
+    from paddle_tpu.v1 import networks as v1nets
+    from paddle_tpu.v1.activations import SoftmaxActivation
+
+    rng = np.random.RandomState(11)
+    TV, H, B, K, L = 9, 8, 2, 3, 4
+    enc = v1.data_layer("nmt_enc", size=H, seq=True)      # [B,T,H] encoded
+    enc_proj = v1.fc_layer(enc, size=H)                   # [B,T,H] projected
+    boot = v1.fc_layer(v1.pooling_layer(enc), size=H)     # decoder boot
+
+    def gru_decoder_with_attention(enc_s, enc_p, cur_word):
+        mem = v1.memory(name="nmt_dec", size=H, boot_layer=boot)
+        ctx = v1nets.simple_attention(encoded_sequence=enc_s,
+                                      encoded_proj=enc_p,
+                                      decoder_state=mem)
+        dec_in = v1.fc_layer([ctx, cur_word], size=3 * H)
+        g = v1.gru_step_layer(dec_in, output_mem=mem, size=H,
+                              name="nmt_dec")
+        return v1.fc_layer(g, size=TV, act=SoftmaxActivation())
+
+    out = v1.beam_search(
+        step=gru_decoder_with_attention,
+        input=[v1.StaticInput(enc, is_seq=True),
+               v1.StaticInput(enc_proj, is_seq=True),
+               v1.GeneratedInput(size=TV, embedding_name="nmt_emb",
+                                 embedding_size=5)],
+        bos_id=0, eos_id=1, beam_size=K, max_length=L)
+    scores = v1.get_output_layer(out, "scores")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lt = LoDTensor.from_sequences(
+        [rng.rand(5, H).astype(np.float32),
+         rng.rand(3, H).astype(np.float32)])
+    ids, sc = exe.run(feed={"nmt_enc": lt},
+                      fetch_list=[out.var, scores.var])
+    ids, sc = np.asarray(ids), np.asarray(sc)
+    assert ids.shape == (B, K, L) and sc.shape == (B, K)
+    assert ids.min() >= 0 and ids.max() < TV
+    assert np.all(np.isfinite(sc[:, 0]))
+
+
+def test_v1_beam_search_num_results_per_sample():
+    from paddle_tpu.v1.activations import SoftmaxActivation
+    rng = np.random.RandomState(5)
+    V, H, B, K, L = 6, 4, 2, 4, 3
+    enc = v1.data_layer("nr_enc", size=H)
+
+    def step(se, cw):
+        prev = v1.memory(name="nr_dec", size=H)
+        hid = v1.fc_layer([se, cw, prev], size=H, name="nr_dec")
+        return v1.fc_layer(hid, size=V, act=SoftmaxActivation())
+
+    out = v1.beam_search(step=step,
+                         input=[v1.StaticInput(enc),
+                                v1.GeneratedInput(size=V, embedding_name="nre",
+                                                  embedding_size=3)],
+                         bos_id=0, eos_id=1, beam_size=K, max_length=L,
+                         num_results_per_sample=2)
+    sc = v1.get_output_layer(out, "scores")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ids, s = exe.run(feed={"nr_enc": rng.rand(B, H).astype(np.float32)},
+                     fetch_list=[out.var, sc.var])
+    assert np.asarray(ids).shape == (B, 2, L)
+    s = np.asarray(s)
+    assert s.shape == (B, 2)
+    assert np.all(s[:, 0] >= s[:, 1])  # lanes score-sorted
+
+    # zero-width projection guard (review finding)
+    fluid.reset()
+    x = v1.data_layer("zx", size=4)
+    with pytest.raises(ValueError, match="resolvable size"):
+        with v1.mixed_layer() as m:
+            m += v1.trans_full_matrix_projection(x)
