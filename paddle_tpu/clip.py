@@ -77,3 +77,74 @@ def append_gradient_clip_by_global_norm(block, params_grads, clip_norm):
                         outputs={"Out": [c.name]}, attrs={"axis": -1})
         out.append((p, c))
     return out
+
+
+class ErrorClipByValue:
+    """reference clip.py ErrorClipByValue: clip a var's GRADIENT during
+    backward via the error_clip attribute."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op("clip", inputs={"X": [grad_name]},
+                        outputs={"Out": [grad_name]},
+                        attrs={"min": self.min, "max": self.max})
+
+
+def error_clip_callback(block, context):
+    """reference clip.py error_clip_callback.  append_backward applies
+    error_clip attrs at grad materialization (propagation-correct); this
+    callback form only covers grads the in-pass hook did not see."""
+    for grad_name in context.get("grad_names", ()):
+        base = grad_name.replace("@GRAD", "")
+        v = block._find_var_recursive(base)
+        clip = getattr(v, "error_clip", None) if v is not None else None
+        if clip is not None and not getattr(v, "_error_clip_applied", False):
+            clip.append_clip_op(block, grad_name)
+
+
+class GradientClipByValue:
+    """reference clip.py GradientClipByValue — object form of
+    append_gradient_clip_by_value, attachable to params via
+    gradient_clip attr or applied with append_gradient_clip_ops."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def apply(self, block, params_grads):
+        return append_gradient_clip_by_value(block, params_grads,
+                                             self.min, self.max)
+
+
+class GradientClipByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, block, params_grads):
+        return append_gradient_clip_by_norm(block, params_grads,
+                                            self.clip_norm)
+
+
+class GradientClipByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, block, params_grads):
+        return append_gradient_clip_by_global_norm(block, params_grads,
+                                                   self.clip_norm)
+
+
+def append_gradient_clip_ops(param_grad):
+    """reference clip.py append_gradient_clip_ops: apply each parameter's
+    gradient_clip attribute (set via ParamAttr) to its gradient."""
+    out = []
+    for p, g in param_grad:
+        clip = getattr(p, "gradient_clip_attr", None)
+        if clip is None:
+            out.append((p, g))
+        else:  # clip ops live where the grad lives (the loss block)
+            out.extend(clip.apply(g.block, [(p, g)]))
+    return out

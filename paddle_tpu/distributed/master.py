@@ -90,6 +90,16 @@ class MasterService:
                 self._done.append(ent[0])
             self._snapshot_locked()
 
+    def put_back(self, task_id: int):
+        """Return an unconsumed task to the queue front (no failure charge):
+        the v2 master client pushes back the first next-epoch task it sees
+        when detecting its pass boundary."""
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is not None:
+                self._todo.insert(0, ent[0])
+            self._snapshot_locked()
+
     def task_failed(self, task_id: int):
         with self._lock:
             ent = self._pending.pop(task_id, None)
@@ -119,6 +129,19 @@ class MasterService:
         with self._lock:
             return {"epoch": self._epoch, "todo": len(self._todo),
                     "pending": len(self._pending), "done": len(self._done)}
+
+    def request_save_model(self, trainer_id: str = "",
+                           block_ms: float = 0.0) -> int:
+        """Arbitrate model saving: exactly one trainer gets a grant per
+        block_ms window (go master RequestSaveModel / etcd-lock semantics,
+        consumed by v2 master.client.request_save_model)."""
+        with self._lock:
+            now = time.time()
+            last = getattr(self, "_save_grant_ts", 0.0)
+            if (now - last) * 1000.0 >= float(block_ms):
+                self._save_grant_ts = now
+                return 1
+            return 0
 
     # -- snapshot/recover (service.go:207/:166; etcd → file) ----------------
     def _snapshot_locked(self):

@@ -18,11 +18,14 @@ from . import unique_name
 from .core import GRAD_SUFFIX, Parameter, Program, Variable, grad_var_name
 
 
-def _compute_requires_grad(block, no_grad_set: Set[str]) -> Set[str]:
+def _compute_requires_grad(block, no_grad_set: Set[str],
+                           extra_sources: Optional[Set[str]] = None
+                           ) -> Set[str]:
     """Forward taint pass: a var requires grad iff it is a trainable Parameter
     or an output of an op with a requiring-grad input, minus stop_gradient /
-    no_grad vars."""
-    req: Set[str] = set()
+    no_grad vars.  `extra_sources` adds explicit taint roots (calc_gradient
+    inputs that are neither Parameters nor data vars)."""
+    req: Set[str] = set(extra_sources or ())
     for v in block.vars.values():
         if isinstance(v, Parameter) and v.trainable and v.name not in no_grad_set:
             req.add(v.name)
@@ -64,10 +67,16 @@ def append_backward(
     loss: Variable,
     parameter_list: Optional[List[str]] = None,
     no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+    extra_sources: Optional[Set[str]] = None,
 ):
     """Append grad ops for `loss` to its block; returns [(param, grad_var)].
 
     Matches fluid backward.py:337's contract used by Optimizer.minimize.
+    `callbacks`: reference backward.py callback hooks — each is called as
+    cb(block, {"grad_names": [...]}) after grads materialize (the
+    error-clip path).  `extra_sources`: additional taint-source var names
+    (calc_gradient's arbitrary inputs).
     """
     block = loss.block
     program: Program = block.program
@@ -75,8 +84,10 @@ def append_backward(
     for v in block.vars.values():
         if v.stop_gradient:
             no_grad.add(v.name)
+    no_grad -= set(extra_sources or ())
 
-    requires_grad = _compute_requires_grad(block, no_grad)
+    requires_grad = _compute_requires_grad(block, no_grad,
+                                           extra_sources=extra_sources)
     if loss.name not in requires_grad:
         raise ValueError(
             f"loss {loss.name!r} does not depend on any trainable parameter"
@@ -127,6 +138,13 @@ def append_backward(
             block.append_op(
                 "print", inputs={"X": [gname]}, outputs={"Out": [gname]},
                 attrs={"message": f"{gname}: "})
+        # error clip applies at materialization, BEFORE upstream grad ops
+        # consume this grad (reference clip.py error_clip_callback inside
+        # _append_backward_ops_) — clipping here propagates backward
+        ec = getattr(v, "error_clip", None) if v is not None else None
+        if ec is not None:
+            ec.append_clip_op(block, gname)
+            v._error_clip_applied = True
         return gname
 
     def record(name: str, grad_name: str):
@@ -210,6 +228,80 @@ def append_backward(
         if v.is_data and not v.stop_gradient:
             if finalize(v.name) is not None:
                 feed_grads += 1
+    for name in (extra_sources or ()):
+        if finalize(name) is not None:
+            feed_grads += 1
     if not result and not feed_grads:
         raise ValueError("append_backward produced no parameter gradients")
+    if callbacks:
+        grad_names = [grad_var_name(p.name) for p, _ in result]
+        grad_names += [grad_var_name(v.name) for v in block.vars.values()
+                       if v.is_data and not v.stop_gradient
+                       and grad_var_name(v.name) in block.vars]
+        for cb in callbacks:
+            cb(block, {"grad_names": grad_names})
     return result
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Backpropagate targets' gradients to inputs (reference fluid
+    backward.py:463 calc_gradient).
+
+    Lowered as a surrogate scalar sum_i <target_i, seed_i> whose backward
+    seeds each target with seed_i (ones when target_gradients is None) —
+    d(sum<t, s>)/dx = J^T s is exactly the requested vector-Jacobian
+    product.  Returns one grad Variable per input, None where the input
+    does not affect the targets."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        seeds = [None] * len(targets)
+    else:
+        seeds = (list(target_gradients)
+                 if isinstance(target_gradients, (list, tuple))
+                 else [target_gradients])
+    if len(seeds) != len(targets):
+        raise ValueError("Should have the same number of target_gradients "
+                         "as targets")
+    block = targets[0].block
+
+    def tmp(dtype):
+        return block.create_var(name=unique_name.generate("calc_grad"),
+                                shape=None, dtype=dtype,
+                                stop_gradient=False)
+
+    parts = []
+    for t, s in zip(targets, seeds):
+        v = t
+        if s is not None:
+            m = tmp(t.dtype)
+            block.append_op("elementwise_mul",
+                            inputs={"X": [t.name], "Y": [s.name]},
+                            outputs={"Out": [m.name]})
+            v = m
+        r = tmp(t.dtype)
+        block.append_op("reduce_sum", inputs={"X": [v.name]},
+                        outputs={"Out": [r.name]},
+                        attrs={"dim": None, "keep_dim": False})
+        parts.append(r)
+    if len(parts) == 1:
+        total = parts[0]
+    else:
+        total = tmp(targets[0].dtype)
+        block.append_op("sum", inputs={"X": [p.name for p in parts]},
+                        outputs={"Out": [total.name]})
+    total.shape = (1,)
+    # un-stop the requested inputs so the taint pass reaches them, but
+    # RESTORE afterwards — a later minimize() on this program must not
+    # inherit data-grad sources from a one-off sensitivity probe
+    prior = [(iv, iv.stop_gradient) for iv in inputs]
+    for iv in inputs:
+        iv.stop_gradient = False
+    try:
+        append_backward(total, no_grad_set=no_grad_set,
+                        extra_sources={iv.name for iv in inputs})
+    finally:
+        for iv, flag in prior:
+            iv.stop_gradient = flag
+    return [block.vars.get(grad_var_name(iv.name)) for iv in inputs]

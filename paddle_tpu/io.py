@@ -191,3 +191,29 @@ def load_merged_model(path, executor, scope=None):
         with tarfile.open(path, "r:gz") as tar:
             tar.extractall(tmp, filter="data")
         return load_inference_model(tmp, executor, scope=scope)
+
+
+def save_params(executor, dirname, main_program=None, scope=None):
+    """Save only Parameter vars (reference io.py save_params — vs
+    save_persistables which also takes optimizer state)."""
+    prog = main_program or default_main_program()
+    names = [v.name for v in prog.global_block().vars.values()
+             if isinstance(v, Parameter)]
+    save_vars(dirname, names, scope=scope)
+
+
+def load_params(executor, dirname, main_program=None, scope=None):
+    prog = main_program or default_main_program()
+    names = [v.name for v in prog.global_block().vars.values()
+             if isinstance(v, Parameter)]
+    load_vars(dirname, names, scope=scope)
+
+
+def get_inference_program(target_vars, main_program=None):
+    """Prune the program to the inference slice feeding target_vars
+    (reference io.py get_inference_program: prune + strip backward)."""
+    prog = main_program or default_main_program()
+    tv = target_vars if isinstance(target_vars, (list, tuple)) \
+        else [target_vars]
+    names = [v if isinstance(v, str) else v.name for v in tv]
+    return _strip_backward(prog, names)

@@ -71,3 +71,13 @@ def dot_product_attention(querys, keys, values):
     weights = layers.softmax(product)
     context = layers.matmul(weights, values)
     return context, weights
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    """reference nets.py sequence_conv_pool: sequence_conv + sequence_pool
+    (the understand_sentiment conv net building block)."""
+    conv = layers.sequence_conv(input, num_filters=num_filters,
+                                filter_size=filter_size,
+                                param_attr=param_attr, act=act)
+    return layers.sequence_pool(conv, pool_type=pool_type)

@@ -72,8 +72,8 @@ def select(ctx, ins, attrs):
     jnp = _jnp()
     mask = ins["Mask"][0]
     x, y = ins["X"][0], ins["Y"][0]
-    while mask.ndim < x.ndim:
-        mask = mask[..., None]
+    while mask.ndim < max(x.ndim, y.ndim):  # either side may be a scalar
+        mask = mask[..., None]              # fill (split_lod_tensor)
     return {"Out": [jnp.where(mask != 0, x, y)]}
 
 
@@ -238,6 +238,9 @@ def create_array(ctx, ins, attrs):
     from ..framework.core import np_dtype
 
     shape = [int(s) for s in attrs["shape"]]  # [cap, ...]
+    if any(s < 0 for s in shape):  # batch-dim element shape: size from Ref
+        ref = ins["Ref"][0]
+        shape = [ref.shape[0] if s < 0 else s for s in shape]
     return {"Out": [jnp.zeros(shape, dtype=np_dtype(
         attrs.get("dtype", "float32")))]}
 

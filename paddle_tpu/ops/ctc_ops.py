@@ -27,6 +27,8 @@ def warpctc(ctx, ins, attrs):
     if logits.dtype not in (jnp.float32, jnp.float64):
         logits = logits.astype(jnp.float32)
     labels = ins["Label"][0].astype(jnp.int32)
+    if labels.ndim == 3 and labels.shape[-1] == 1:  # [B,L,1] slot form
+        labels = labels[..., 0]
     logit_lens = ins["LogitsLength"][0]
     label_lens = ins["LabelLength"][0]
     blank = int(attrs.get("blank", 0))

@@ -69,7 +69,7 @@ def sequence_softmax(ctx, ins, attrs):
     return {"Out": [jax.nn.softmax(logits, axis=-1).astype(x.dtype) * m.astype(x.dtype)]}
 
 
-@register_op("sequence_expand", non_diff_inputs=("Length",))
+@register_op("sequence_expand", non_diff_inputs=("Length", "Ref"))
 def sequence_expand(ctx, ins, attrs):
     """Broadcast one row per sequence across its timesteps:
     [B,D]+len → [B,T,D] masked (the padded-batch reading of
@@ -78,7 +78,9 @@ def sequence_expand(ctx, ins, attrs):
 
     x = ins["X"][0]
     lengths = ins["Length"][0]
-    T = int(attrs["max_len"])
+    T = int(attrs.get("max_len", -1))
+    if T < 0:  # dynamic build-time T: take it from the reference sequence
+        T = ins["Ref"][0].shape[1]
     out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
     m = _mask(lengths, T, x.dtype)
     while m.ndim < out.ndim:

@@ -381,3 +381,17 @@ def pipeline_stage(ctx, ins, attrs):
     """Stage-boundary marker for parallel.ProgramPipeline; pure no-op under
     the single-device Executor so the same program runs unchanged there."""
     return {}
+
+
+@register_op("arg_sort", grad=None)
+def arg_sort(ctx, ins, attrs):
+    """Ascending argsort along `axis` (backs lod_rank_table's
+    length-descending order via a negated input).  A [B,1] column vector
+    squeezes to [B] first (the length-var slot shape); every other shape
+    sorts with plain jnp.argsort semantics."""
+    jnp = _j()
+    x = ins["X"][0]
+    if x.ndim == 2 and x.shape[1] == 1:
+        x = x[:, 0]
+    return {"Out": [jnp.argsort(x, axis=int(attrs.get("axis", 0))
+                                ).astype(jnp.int64)]}

@@ -75,6 +75,7 @@ class Optimizer:
 
         # reference order (fluid optimizer.py:216-219): clip first, then add
         # weight decay — decay must not be scaled down by the clip
+        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
         if self.global_clip_norm is not None:
             params_grads = clip_mod.append_gradient_clip_by_global_norm(
                 block, params_grads, self.global_clip_norm)
@@ -95,7 +96,10 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        from . import clip as clip_mod
+        params_grads = append_backward(
+            loss, parameter_list, no_grad_set,
+            callbacks=[clip_mod.error_clip_callback])
         ops = self.create_optimization_pass(params_grads, loss)
         return ops, params_grads
 

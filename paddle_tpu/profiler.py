@@ -111,3 +111,36 @@ def cuda_profiler(*a, **kw):
     """API-parity shim for fluid.profiler.cuda_profiler (profiler.py:32):
     device tracing on TPU goes through `profiler(trace_dir=...)`."""
     yield
+
+
+class CudaProfiler:
+    """Class-form parity for the reference's nvprof hooks
+    (platform/cuda_profiler.h, pybind.cc:474): start/stop map to the
+    jax.profiler-backed `profiler` context on TPU."""
+
+    def __init__(self, output_file=None, output_mode=None, config=None):
+        self.output_file = output_file
+        self._cm = None
+
+    def start(self):
+        import jax
+        if self.output_file:
+            try:
+                jax.profiler.start_trace(str(self.output_file))
+                self._cm = True
+            except Exception:
+                self._cm = None
+
+    def stop(self):
+        import jax
+        if self._cm:
+            jax.profiler.stop_trace()
+            self._cm = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

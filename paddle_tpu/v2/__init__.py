@@ -23,6 +23,13 @@ from . import layer  # noqa: F401
 from . import pooling  # noqa: F401
 from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
+from . import master  # noqa: F401
+from . import topology  # noqa: F401
+from .topology import Topology  # noqa: F401
+from ..framework.core import (  # noqa: F401
+    default_main_program,
+    default_startup_program,
+)
 from ..v1 import networks  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import parameters  # noqa: F401
