@@ -34,10 +34,18 @@ from . import net_drawer  # noqa: F401
 from . import reader  # noqa: F401
 from .data_feeder import DataFeeder, DeviceFeeder  # noqa: F401
 from .lod import LoDTensor  # noqa: F401
+Tensor = LoDTensor  # reference fluid alias (__init__.py Tensor)
 from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
-from .framework.backward import append_backward  # noqa: F401
+from .framework import backward  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .framework.scope import scope_guard, switch_scope  # noqa: F401
+from .framework.backward import append_backward, calc_gradient  # noqa: F401
+from .distributed.distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    SimpleDistributeTranspiler,
+)
 from .framework.core import (  # noqa: F401
     Block,
     Operator,

@@ -1,0 +1,195 @@
+"""Fluid DistributeTranspiler (reference python/paddle/v2/fluid/
+distribute_transpiler.py:76 DistributeTranspiler.transpile /
+:34 split_dense_variable, and distribute_transpiler_simple.py).
+
+Reference mechanism: rewrite the single-process program into a trainer
+program whose grads flow through send/recv gRPC ops and per-pserver
+programs that run the optimizer sub-block (recv_op.cc:37 kOptimizeBlock).
+
+TPU-native redesign: in-graph send/recv host ops would force a host
+round-trip inside the compiled XLA step, so the split happens at the
+program level instead — transpile() strips the optimizer ops out of the
+trainer program (forward+backward stays one compiled XLA program, grads
+are fetched) and hands each parameter's update rule to the host parameter
+service (distributed/pserver.py, the ParameterServer2/Go-pserver
+equivalent).  A RemoteUpdater pushes fetched grads and pulls fresh params
+between steps — the RemoteParameterUpdater hot loop
+(TrainerInternal.cpp:119) with the same BSP/async semantics, while
+in-graph data parallelism stays the job of pjit/ICI collectives."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.core import Program, default_main_program
+from .pserver import ParameterClient
+
+# host-service update rules (pserver.py _OPTIMIZERS) reachable from the
+# graph optimizer ops
+_OP_TO_CFG = {
+    "sgd": lambda a: {"type": "sgd"},
+    "momentum": lambda a: {"type": "momentum",
+                           "momentum": float(a.get("mu", 0.9))},
+    "adagrad": lambda a: {"type": "adagrad",
+                          "epsilon": float(a.get("epsilon", 1e-6))},
+    "adam": lambda a: {"type": "adam",
+                       "beta1": float(a.get("beta1", 0.9)),
+                       "beta2": float(a.get("beta2", 0.999)),
+                       "epsilon": float(a.get("epsilon", 1e-8))},
+}
+
+OPTIMIZE_OP_TYPES = ("sgd", "momentum", "adagrad", "adam", "adamax",
+                     "adadelta", "decayed_adagrad", "proximal_gd",
+                     "proximal_adagrad", "ftrl", "rmsprop")
+
+
+class DistributeTranspiler:
+    def transpile(self, trainer_id, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  split_method=None):
+        """Split the program into trainer + pserver roles (reference
+        transpile :76).  `pservers` is the comma-separated endpoint list;
+        parameters map to endpoints by name hash (go client.go), whole-var
+        (the simple-transpiler split; block-slicing a var buys nothing
+        when the update is a host-side numpy op)."""
+        self.trainer_id = str(trainer_id)
+        self.trainers = int(trainers)
+        self.endpoints: List[str] = [e.strip() for e in pservers.split(",")
+                                     if e.strip()]
+        if not self.endpoints:
+            raise ValueError("transpile needs at least one pserver "
+                             "endpoint (pservers='host:port,...')")
+        self.program = program or default_main_program()
+        block = self.program.global_block()
+        self.param_cfg: Dict[str, dict] = {}
+        self.param_grad: Dict[str, str] = {}
+        kept = []
+        for op in block.ops:
+            if op.type in OPTIMIZE_OP_TYPES:
+                pname = op.inputs["Param"][0]
+                mk = _OP_TO_CFG.get(op.type)
+                if mk is None:
+                    raise NotImplementedError(
+                        f"pserver-side update for {op.type!r} is not "
+                        f"implemented (host rules: "
+                        f"{sorted(_OP_TO_CFG)}); keep this optimizer "
+                        f"local or use a supported rule")
+                cfg = mk(op.attrs or {})
+                lr = (op.inputs.get("LearningRate") or [None])[0]
+                cfg["_lr_var"] = lr  # resolved from scope at init time
+                self.param_cfg[pname] = cfg
+                self.param_grad[pname] = op.inputs["Grad"][0]
+            else:
+                kept.append(op)
+        block.ops[:] = kept
+        self.program._bump()
+        from .pserver import server_for
+        self.param_endpoint = {p: server_for(p, self.endpoints)
+                               for p in self.param_cfg}
+        return self
+
+    # -- role programs ------------------------------------------------------
+    def get_trainer_program(self) -> Program:
+        """Forward+backward only; one compiled XLA step, grads fetchable."""
+        return self.program
+
+    def get_pserver_program(self, endpoint: str) -> Dict[str, dict]:
+        """The optimize-block equivalent for one pserver: parameter ->
+        host update rule it will run (reference built a sub-program with
+        optimizer ops; the host service consumes the rule directly)."""
+        return {p: {k: v for k, v in cfg.items() if k != "_lr_var"}
+                for p, cfg in self.param_cfg.items()
+                if self.param_endpoint[p] == endpoint}
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Parity shim: pserver state is seeded by trainer-0's init
+        (init_param carries values + rule), not by a startup program."""
+        from ..framework.core import default_startup_program
+        return default_startup_program()
+
+    # -- runtime ------------------------------------------------------------
+    def grad_fetch_list(self):
+        block = self.program.global_block()
+        return [block.var(g) for g in self.param_grad.values()]
+
+    def make_updater(self, scope=None) -> "RemoteUpdater":
+        return RemoteUpdater(self, scope)
+
+
+class SimpleDistributeTranspiler(DistributeTranspiler):
+    """reference distribute_transpiler_simple.py: whole-variable placement
+    instead of block slicing — which is exactly this transpiler's split."""
+
+
+class RemoteUpdater:
+    """RemoteParameterUpdater / NewRemoteParameterUpdater capability
+    (RemoteParameterUpdater.h:55, go cclient): trainer-0 seeds the service,
+    then each step pushes grads and pulls fresh params into the scope."""
+
+    def __init__(self, transpiler: DistributeTranspiler, scope=None):
+        from ..framework.scope import global_scope
+
+        self.t = transpiler
+        self.scope = scope or global_scope()
+        self.client = ParameterClient(self.t.endpoints, self.t.trainer_id)
+
+    def _lr_of(self, cfg) -> float:
+        lr_var = cfg.get("_lr_var")
+        if lr_var is None:
+            return 0.01  # optimizer op carried no LR var (host default)
+        v = self.scope.find(lr_var)
+        if v is None:
+            raise RuntimeError(
+                f"learning-rate var {lr_var!r} not found in the updater's "
+                f"scope — run the startup program into this scope before "
+                f"init_params() (a silent default would override the "
+                f"configured LR)")
+        return float(np.asarray(v).reshape(-1)[0])
+
+    def init_params(self, timeout_s: float = 120.0):
+        """paddle_begin_init_params flow: only trainer 0 seeds values
+        (cclient.go:145 — others wait on the init barrier, bounded by
+        `timeout_s` like the BSP grad barrier)."""
+        import time
+
+        if self.t.trainer_id in ("0", "trainer_0", ""):
+            for pname, cfg in self.t.param_cfg.items():
+                value = self.scope.find_np(pname)
+                if value is None:
+                    raise RuntimeError(
+                        f"parameter {pname!r} not initialized in the "
+                        f"updater's scope — run the startup program first")
+                rule = {k: v for k, v in cfg.items() if k != "_lr_var"}
+                rule["lr"] = self._lr_of(cfg)
+                self.client.init_param(pname, value, rule)
+            self.client.finish_init_params()
+        else:
+            deadline = time.time() + timeout_s
+            while not self.client.initialized():
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"pservers not initialized after {timeout_s}s — "
+                        f"did trainer 0 run init_params()?")
+                time.sleep(0.05)
+            self.pull_params()
+
+    def step(self, grads: Dict[str, np.ndarray]):
+        """One remote update round: push this trainer's grads (keyed by
+        param OR grad name), then refresh local params."""
+        by_param = {}
+        for pname, gname in self.t.param_grad.items():
+            if pname in grads:
+                by_param[pname] = np.asarray(grads[pname])
+            elif gname in grads:
+                by_param[pname] = np.asarray(grads[gname])
+        self.client.send_grads(by_param)
+        self.pull_params()
+
+    def pull_params(self):
+        for pname in self.t.param_cfg:
+            self.scope.set(pname, self.client.get_param(pname))
+
+    def close(self):
+        self.client.close()

@@ -482,6 +482,13 @@ class PServer(socketserver.ThreadingTCPServer):
         self.server_close()
 
 
+def server_for(name: str, endpoints: List[str]) -> str:
+    """Deterministic param->pserver assignment by name hash (go
+    client.go's selection); pure — usable without a client/socket."""
+    h = int(hashlib.md5(name.encode()).hexdigest(), 16)
+    return endpoints[h % len(endpoints)]
+
+
 class ParameterClient:
     """Trainer-side client (go/pserver/client/c/cclient.go exports /
     ParameterClient2).  Parameters are assigned to pservers by name hash
@@ -501,8 +508,7 @@ class ParameterClient:
         return self._socks[endpoint]
 
     def _server_for(self, name: str) -> str:
-        h = int(hashlib.md5(name.encode()).hexdigest(), 16)
-        return self.endpoints[h % len(self.endpoints)]
+        return server_for(name, self.endpoints)
 
     def _call(self, endpoint, header, payload=b""):
         sock = self._sock(endpoint)

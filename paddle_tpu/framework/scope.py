@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import contextlib
+
 import numpy as np
 
 
@@ -61,3 +63,22 @@ def reset_global_scope():
     global _global_scope
     _global_scope = Scope()
     return _global_scope
+
+
+def switch_scope(scope: Scope) -> Scope:
+    """Swap the process-global scope (reference executor.py switch_scope);
+    returns the previous one."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    return prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """with scope_guard(Scope()): ... (reference executor.py scope_guard)."""
+    prev = switch_scope(scope)
+    try:
+        yield scope
+    finally:
+        switch_scope(prev)

@@ -1,0 +1,74 @@
+"""DistributeTranspiler role split (reference fluid
+distribute_transpiler.py:76 + distribute_transpiler_simple.py) over the
+host parameter service: trainer program keeps forward+backward as one XLA
+program, pservers run the update rules, RemoteUpdater is the
+RemoteParameterUpdater hot loop."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.distributed.pserver import ParameterServerService, PServer
+
+
+def _start_pserver():
+    srv = PServer(num_trainers=1, mode="bsp")
+    srv.start()
+    host, port = srv.server_address
+    return srv.service, srv, f"{host}:{port}"
+
+
+def test_transpile_splits_roles_and_trains():
+    rng = np.random.RandomState(0)
+    x = layers.data("dtx", shape=[4], dtype="float32")
+    y = layers.data("dty", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+
+    svc1, srv1, ep1 = _start_pserver()
+    svc2, srv2, ep2 = _start_pserver()
+    try:
+        t = fluid.DistributeTranspiler()
+        t.transpile(0, pservers=f"{ep1},{ep2}", trainers=1)
+        prog = t.get_trainer_program()
+        ops = [op.type for op in prog.global_block().ops]
+        assert "sgd" not in ops  # optimizer left the trainer program
+        # every param owned by exactly one endpoint, rules delivered there
+        cfgs = {**t.get_pserver_program(ep1), **t.get_pserver_program(ep2)}
+        assert set(cfgs) == set(t.param_cfg)
+        assert all(c["type"] == "sgd" for c in cfgs.values())
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        upd = t.make_updater()
+        upd.init_params()
+        W = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        losses = []
+        gvars = t.grad_fetch_list()
+        gnames = [g.name for g in gvars]
+        for _ in range(150):
+            xv = rng.rand(32, 4).astype(np.float32)
+            yv = xv @ W
+            outs = exe.run(feed={"dtx": xv, "dty": yv},
+                           fetch_list=[cost] + gvars)
+            losses.append(float(np.asarray(outs[0]).reshape(())))
+            upd.step(dict(zip(gnames, outs[1:])))
+        assert losses[-1] < losses[0] * 0.05, losses[:3] + losses[-3:]
+        upd.close()
+    finally:
+        srv1.stop()
+        srv2.stop()
+
+
+def test_simple_transpiler_alias_and_errors():
+    x = layers.data("stx", shape=[2], dtype="float32")
+    cost = layers.mean(layers.fc(x, size=1))
+    fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(cost)
+    t = fluid.SimpleDistributeTranspiler()
+    import pytest
+    with pytest.raises(ValueError, match="endpoint"):
+        t.transpile(0, pservers="")
+    t.transpile(0, pservers="127.0.0.1:1")  # no connection at transpile time
+    (cfg,) = [c for c in t.param_cfg.values() if c["type"] == "adam"][:1]
+    assert "beta1" in cfg
