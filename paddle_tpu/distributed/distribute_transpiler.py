@@ -31,7 +31,9 @@ from .pserver import ParameterClient
 _OP_TO_CFG = {
     "sgd": lambda a: {"type": "sgd"},
     "momentum": lambda a: {"type": "momentum",
-                           "momentum": float(a.get("mu", 0.9))},
+                           "momentum": float(a.get("mu", 0.9)),
+                           "use_nesterov": bool(a.get("use_nesterov",
+                                                      False))},
     "adagrad": lambda a: {"type": "adagrad",
                           "epsilon": float(a.get("epsilon", 1e-6))},
     "adam": lambda a: {"type": "adam",
@@ -45,10 +47,24 @@ OPTIMIZE_OP_TYPES = ("sgd", "momentum", "adagrad", "adam", "adamax",
                      "proximal_adagrad", "ftrl", "rmsprop")
 
 
+def _static_lr(lr_var_name, startup_program=None):
+    """Resolve a constant learning rate from the startup program's init op
+    (LR schedules stay dynamic -> resolved from the scope at init time)."""
+    if lr_var_name is None:
+        return None
+    from ..framework.core import default_startup_program
+    prog = startup_program or default_startup_program()
+    for op in prog.global_block().ops:
+        if (op.type == "fill_constant"
+                and op.outputs.get("Out") == [lr_var_name]):
+            return float(op.attrs.get("value", 0.01))
+    return None
+
+
 class DistributeTranspiler:
     def transpile(self, trainer_id, program: Optional[Program] = None,
                   pservers: str = "", trainers: int = 1,
-                  split_method=None):
+                  split_method=None, startup_program: Optional[Program] = None):
         """Split the program into trainer + pserver roles (reference
         transpile :76).  `pservers` is the comma-separated endpoint list;
         parameters map to endpoints by name hash (go client.go), whole-var
@@ -78,7 +94,10 @@ class DistributeTranspiler:
                         f"local or use a supported rule")
                 cfg = mk(op.attrs or {})
                 lr = (op.inputs.get("LearningRate") or [None])[0]
-                cfg["_lr_var"] = lr  # resolved from scope at init time
+                cfg["_lr_var"] = lr
+                static = _static_lr(lr, startup_program)  # init-op value
+                if static is not None:
+                    cfg["lr"] = static
                 self.param_cfg[pname] = cfg
                 self.param_grad[pname] = op.inputs["Grad"][0]
             else:
@@ -98,7 +117,11 @@ class DistributeTranspiler:
     def get_pserver_program(self, endpoint: str) -> Dict[str, dict]:
         """The optimize-block equivalent for one pserver: parameter ->
         host update rule it will run (reference built a sub-program with
-        optimizer ops; the host service consumes the rule directly)."""
+        optimizer ops; the host service consumes the rule directly).
+        Constant learning rates are resolved into the rule at transpile
+        time; an LR-schedule-driven rate is only known at runtime and is
+        delivered by trainer-0's init_param instead (rule['lr'] absent
+        here marks that case)."""
         return {p: {k: v for k, v in cfg.items() if k != "_lr_var"}
                 for p, cfg in self.param_cfg.items()
                 if self.param_endpoint[p] == endpoint}
@@ -138,8 +161,10 @@ class RemoteUpdater:
     def _lr_of(self, cfg) -> float:
         lr_var = cfg.get("_lr_var")
         if lr_var is None:
-            return 0.01  # optimizer op carried no LR var (host default)
+            return cfg.get("lr", 0.01)  # no LR var on the op
         v = self.scope.find(lr_var)
+        if v is None and "lr" in cfg:
+            return cfg["lr"]  # constant resolved at transpile time
         if v is None:
             raise RuntimeError(
                 f"learning-rate var {lr_var!r} not found in the updater's "
@@ -154,6 +179,21 @@ class RemoteUpdater:
         `timeout_s` like the BSP grad barrier)."""
         import time
 
+        # the service's trainer count must match the job's (BSP divisor
+        # and barrier width live server-side)
+        for ep in self.t.endpoints:
+            try:
+                cfg_srv = self.client._call(ep, {"op": "get_config"})[0][
+                    "value"]
+            except RuntimeError:
+                continue  # older server without the RPC
+            if int(cfg_srv["num_trainers"]) != self.t.trainers:
+                raise RuntimeError(
+                    f"pserver {ep} is configured for "
+                    f"{cfg_srv['num_trainers']} trainers but transpile() "
+                    f"declared {self.t.trainers} — BSP averaging would be "
+                    f"wrong; start the pserver with num_trainers="
+                    f"{self.t.trainers}")
         if self.t.trainer_id in ("0", "trainer_0", ""):
             for pname, cfg in self.t.param_cfg.items():
                 value = self.scope.find_np(pname)
@@ -179,11 +219,20 @@ class RemoteUpdater:
         """One remote update round: push this trainer's grads (keyed by
         param OR grad name), then refresh local params."""
         by_param = {}
+        known = set()
         for pname, gname in self.t.param_grad.items():
+            known.update((pname, gname))
             if pname in grads:
                 by_param[pname] = np.asarray(grads[pname])
             elif gname in grads:
                 by_param[pname] = np.asarray(grads[gname])
+        stray = set(grads) - known
+        if known and (stray or not by_param):
+            raise KeyError(
+                f"step() grads keys {sorted(stray) or sorted(grads)} match "
+                f"no transpiled param/grad name (expected any of "
+                f"{sorted(known)}) — an empty push would still consume a "
+                f"BSP round and silently train nothing")
         self.client.send_grads(by_param)
         self.pull_params()
 

@@ -83,15 +83,18 @@ class HostSGD(HostOptimizer):
 
 
 class HostMomentum(HostOptimizer):
-    def __init__(self, lr=0.01, momentum=0.9):
+    def __init__(self, lr=0.01, momentum=0.9, use_nesterov=False):
         super().__init__(lr)
         self.mu = momentum
+        self.nesterov = bool(use_nesterov)
         self.velocity: Optional[np.ndarray] = None
 
     def update(self, param, grad):
         if self.velocity is None:
             self.velocity = np.zeros_like(param)
         self.velocity = self.mu * self.velocity + grad
+        if self.nesterov:  # momentum_op.h use_nesterov lookahead
+            return param - self.lr * (grad + self.mu * self.velocity)
         return param - self.lr * self.velocity
 
     def _state_arrays(self):
@@ -419,6 +422,9 @@ class _PServerHandler(socketserver.BaseRequestHandler):
             return {"ok": True}, b""
         if op == "initialized":
             return {"ok": True, "value": svc.initialized()}, b""
+        if op == "get_config":
+            return {"ok": True, "value": {"num_trainers": svc.num_trainers,
+                                          "mode": svc.mode}}, b""
         if op == "send_grad":
             descs = header["arrays"]
             grads, off = {}, 0

@@ -1,0 +1,21 @@
+"""`paddle.proto` namespace (reference proto/: ModelConfig, TrainerConfig,
+DataFormat, ParameterConfig protobufs — 1656 lines consumed by the v1
+stack).
+
+Design shift: the four reference schemas collapse into ONE interchange
+schema — the Program protobuf (framework/framework.proto, the fluid
+ProgramDesc) — because the Program subsumes the model topology
+(ModelConfig), the optimizer/trainer settings (TrainerConfig: optimizer
+ops are IN the program), and parameter metadata (ParameterConfig: Var
+descs).  DataFormat's slot declarations live on the data-provider slot
+types (trainer/PyDataProvider2).  `framework_pb2` is the generated
+module; the reference names alias it so `from paddle.proto import
+ModelConfig_pb2` still imports."""
+
+from ..framework._gen import framework_pb2  # noqa: F401
+
+# reference module names -> the one interchange schema
+ModelConfig_pb2 = framework_pb2
+TrainerConfig_pb2 = framework_pb2
+ParameterConfig_pb2 = framework_pb2
+DataConfig_pb2 = framework_pb2

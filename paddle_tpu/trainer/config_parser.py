@@ -1,0 +1,52 @@
+"""reference python/paddle/trainer/config_parser.py:4345 parse_config.
+
+Design shift: v1 configs built a ModelConfig protobuf for the C++
+trainer; here building the config (calling the layer functions) IS the
+parse — the Program is the config.  parse_config keeps the reference
+entrypoint: it accepts a config callable (or module path) plus a
+config_arg string, builds it, and returns an object exposing the same
+`model_config` handle (the Program) and its serialized form."""
+
+from __future__ import annotations
+
+import importlib
+import runpy
+
+from ..framework import proto_io
+from ..framework.core import default_main_program
+
+
+class ParsedConfig:
+    def __init__(self, program):
+        self.program = program
+        #  reference returned TrainerConfig with .model_config inside
+        self.model_config = program
+
+    def SerializeToString(self):
+        return proto_io.serialize_program(self.program)
+
+
+def parse_config(config, config_arg_str=""):
+    """config: callable building the net, or a module/script path whose
+    import builds it (the reference's two forms).  config_arg_str becomes
+    kwargs for callables taking them (reference passed it via
+    get_config_arg)."""
+    if callable(config):
+        try:
+            config()
+        except TypeError:
+            kwargs = dict(kv.split("=", 1) for kv in
+                          config_arg_str.split(",") if "=" in kv)
+            config(**kwargs)
+    elif isinstance(config, str):
+        if config.endswith(".py"):
+            runpy.run_path(config)
+        else:
+            importlib.import_module(config)
+    else:
+        raise TypeError("parse_config expects a callable or module path")
+    return ParsedConfig(default_main_program())
+
+
+def parse_config_and_serialize(config, config_arg_str=""):
+    return parse_config(config, config_arg_str).SerializeToString()

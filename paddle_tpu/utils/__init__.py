@@ -1,0 +1,21 @@
+"""Tooling package (reference python/paddle/utils/): image preprocessing,
+log-curve plotting, proto dumping, model merging, torch parameter import.
+
+Mapping to the reference tool scripts:
+- image_util / preprocess_img -> `image_util` (the v2 image utilities) +
+  `preprocess_img.ImageClassificationDatasetCreater`
+- plotcurve -> `plotcurve.plot_paddle_curve`
+- show_pb -> `show_pb.dump_program`
+- merge_model -> io.merge_model (re-exported)
+- dump_config -> the `paddle dump_config` CLI (cli.py)
+- make_model_diagram -> net_drawer (re-exported)
+- torch2paddle -> `torch2paddle.torch_state_to_scope`
+"""
+
+from .. import net_drawer as make_model_diagram  # noqa: F401
+from ..io import merge_model  # noqa: F401
+from ..v2 import image as image_util  # noqa: F401
+from . import plotcurve  # noqa: F401
+from . import preprocess_img  # noqa: F401
+from . import show_pb  # noqa: F401
+from . import torch2paddle  # noqa: F401

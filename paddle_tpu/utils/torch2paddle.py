@@ -1,0 +1,55 @@
+"""Import torch parameters into a paddle_tpu scope (reference
+python/paddle/utils/torch2paddle.py — converted torch .t7 files into
+paddle model files for weight transplants).
+
+Modernized: consumes a `torch.nn.Module.state_dict()` (or any
+name->tensor mapping) directly — torch (CPU) ships in this environment —
+and writes the arrays into a Scope / Parameters object by name map.
+Linear weights transpose automatically: torch stores [out, in], the fc
+op multiplies with [in, out]."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["state_dict_to_arrays", "torch_state_to_scope"]
+
+
+def state_dict_to_arrays(state_dict, name_map=None, transpose_linear=True):
+    """-> {paddle_name: np.ndarray}.  `name_map` maps torch param names to
+    paddle var names (identity by default)."""
+    out = {}
+    for tname, value in state_dict.items():
+        pname = (name_map or {}).get(tname, tname)
+        if pname is None:
+            continue
+        arr = np.asarray(getattr(value, "detach", lambda: value)().cpu()
+                         if hasattr(value, "cpu") else value)
+        if transpose_linear and tname.endswith("weight") and arr.ndim == 2:
+            arr = arr.T  # torch Linear [out,in] -> fc mul [in,out]
+        out[pname] = np.ascontiguousarray(arr)
+    return out
+
+
+def torch_state_to_scope(state_dict, scope=None, name_map=None,
+                         transpose_linear=True, strict=True):
+    """Write converted arrays into the scope; with strict=True every
+    target name must already exist (shape-checked)."""
+    from ..framework.scope import global_scope
+
+    scope = scope or global_scope()
+    arrays = state_dict_to_arrays(state_dict, name_map, transpose_linear)
+    for name, arr in arrays.items():
+        cur = scope.find_np(name)
+        if cur is None:
+            if strict:
+                raise KeyError(
+                    f"target parameter {name!r} not found in scope (run "
+                    f"the startup program first, or pass name_map)")
+            continue
+        if tuple(cur.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"shape mismatch for {name!r}: scope {cur.shape} vs "
+                f"torch {arr.shape} (transpose_linear={transpose_linear})")
+        scope.set(name, arr.astype(cur.dtype, copy=False))
+    return sorted(arrays)

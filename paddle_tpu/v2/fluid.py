@@ -1,0 +1,60 @@
+"""`paddle.v2.fluid` namespace alias (reference python/paddle/v2/fluid/):
+the fluid API lives at the paddle_tpu package root; reference book/test
+scripts written as
+
+    import paddle.v2 as paddle
+    import paddle.v2.fluid as fluid
+    from paddle.v2.fluid.layers import fc
+
+run against this module unchanged (module identity is preserved, so
+`fluid.layers is paddle_tpu.layers`)."""
+
+from __future__ import annotations
+
+import sys as _sys
+
+import paddle_tpu as _root
+from paddle_tpu import *  # noqa: F401,F403
+from paddle_tpu import (  # noqa: F401
+    DataFeeder,
+    DistributeTranspiler,
+    Executor,
+    LoDTensor,
+    ParamAttr,
+    SimpleDistributeTranspiler,
+    Tensor,
+    layers,
+    nets,
+    optimizer,
+    regularizer,
+    clip,
+    evaluator,
+    io,
+    profiler,
+    initializer,
+)
+from paddle_tpu.framework import backward, core  # noqa: F401
+from paddle_tpu.framework.backward import append_backward  # noqa: F401
+from paddle_tpu.memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+)
+
+# make `import paddle_tpu.v2.fluid.<sub>` resolve to the root modules
+for _name, _mod in {
+    "layers": _root.layers,
+    "nets": _root.nets,
+    "optimizer": _sys.modules["paddle_tpu.optimizer"],
+    "regularizer": _sys.modules["paddle_tpu.regularizer"],
+    "clip": _sys.modules["paddle_tpu.clip"],
+    "evaluator": _sys.modules["paddle_tpu.evaluator"],
+    "io": _sys.modules["paddle_tpu.io"],
+    "profiler": _sys.modules["paddle_tpu.profiler"],
+    "initializer": _sys.modules["paddle_tpu.framework.initializer"],
+    "backward": _sys.modules["paddle_tpu.framework.backward"],
+    "core": _sys.modules["paddle_tpu.framework.core"],
+    "framework": _sys.modules["paddle_tpu.framework.core"],
+    "executor": _sys.modules["paddle_tpu.framework.executor"],
+    "param_attr": _sys.modules["paddle_tpu.framework.param_attr"],
+}.items():
+    _sys.modules[__name__ + "." + _name] = _mod
+    setattr(_sys.modules[__name__], _name, _mod)

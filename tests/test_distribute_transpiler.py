@@ -72,3 +72,54 @@ def test_simple_transpiler_alias_and_errors():
     t.transpile(0, pservers="127.0.0.1:1")  # no connection at transpile time
     (cfg,) = [c for c in t.param_cfg.values() if c["type"] == "adam"][:1]
     assert "beta1" in cfg
+
+
+def test_transpile_carries_nesterov_and_static_lr():
+    x = layers.data("nvx", shape=[2], dtype="float32")
+    cost = layers.mean(layers.fc(x, size=1))
+    fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, use_nesterov=True).minimize(cost)
+    t = fluid.DistributeTranspiler().transpile(0, pservers="h:1")
+    (cfg,) = list(t.get_pserver_program("h:1").values())[:1]
+    assert cfg["use_nesterov"] is True
+    assert abs(cfg["lr"] - 0.05) < 1e-9  # constant LR resolved statically
+
+
+def test_updater_rejects_mismatched_trainers_and_stray_grads():
+    import pytest
+    x = layers.data("mmx", shape=[2], dtype="float32")
+    cost = layers.mean(layers.fc(x, size=1))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+    svc, srv, ep = _start_pserver()  # num_trainers=1
+    try:
+        t = fluid.DistributeTranspiler().transpile(0, pservers=ep,
+                                                   trainers=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        upd = t.make_updater()
+        with pytest.raises(RuntimeError, match="BSP averaging"):
+            upd.init_params()
+        upd.close()
+    finally:
+        srv.stop()
+
+    # stray-grad rejection on a fresh job
+    fluid.reset()
+    x = layers.data("mmx2", shape=[2], dtype="float32")
+    cost = layers.mean(layers.fc(x, size=1))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+    svc, srv, ep = _start_pserver()
+    try:
+        t = fluid.DistributeTranspiler().transpile(0, pservers=ep,
+                                                   trainers=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        upd = t.make_updater()
+        upd.init_params()
+        with pytest.raises(KeyError, match="match no transpiled"):
+            upd.step({"not_a_grad": np.zeros(2, np.float32)})
+        with pytest.raises(KeyError, match="match no transpiled"):
+            upd.step({})  # empty push would still consume a BSP round
+        upd.close()
+    finally:
+        srv.stop()

@@ -1,0 +1,101 @@
+"""Tool package (reference python/paddle/utils/): plotcurve parsing,
+show_pb proto dump, torch param import, image dataset preprocessing."""
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, utils
+
+
+def test_plotcurve_extracts_rows():
+    log = _io.StringIO(
+        "I Pass=0 Batch=10 AvgCost=2.5 Eval:\n"
+        "I Pass=1 Batch=20 AvgCost=1.25 Eval:\n"
+        "Test samples=100 AvgCost=1.5 Eval:\n")
+    x, xt = utils.plotcurve.extract_curve(["AvgCost"], log)
+    np.testing.assert_allclose(x, [[0, 2.5], [1, 1.25]])
+    np.testing.assert_allclose(xt, [[100, 1.5]])
+
+
+def test_show_pb_dumps_program(capsys):
+    x = layers.data("pbx", shape=[3], dtype="float32")
+    layers.fc(x, size=2)
+    from paddle_tpu.framework import proto_io
+    blob = proto_io.serialize_program(fluid.default_main_program())
+    prog = utils.show_pb.dump_program(blob)
+    out = capsys.readouterr().out
+    assert "op mul" in out and "var pbx" in out
+    assert len(prog.global_block().ops) >= 2
+
+
+def test_torch2paddle_state_import():
+    torch = pytest.importorskip("torch")
+    x = layers.data("t2px", shape=[4], dtype="float32")
+    y = layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    blk = fluid.default_main_program().global_block()
+    wname = [v.name for v in blk.vars.values() if v.name.endswith(".w_0")
+             or ".w" in v.name][0]
+    bname = [v.name for v in blk.vars.values() if v.name.endswith(".b_0")
+             or ".b" in v.name][0]
+    lin = torch.nn.Linear(4, 3)
+    names = utils.torch2paddle.torch_state_to_scope(
+        lin.state_dict(), name_map={"weight": wname, "bias": bname})
+    assert sorted(names) == sorted([wname, bname])
+    got = fluid.global_scope().find_np(wname)
+    np.testing.assert_allclose(got, lin.weight.detach().numpy().T,
+                               rtol=1e-6)
+    # imported weights drive the forward pass
+    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    (o,) = exe.run(feed={"t2px": xv}, fetch_list=[y])
+    want = xv @ lin.weight.detach().numpy().T + lin.bias.detach().numpy()
+    np.testing.assert_allclose(o, want, rtol=1e-4)
+
+
+def test_preprocess_img_dataset_creater(tmp_path):
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            from PIL import Image
+            arr = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / cls / f"{i}.jpg")
+    c = utils.preprocess_img.ImageClassificationDatasetCreater(
+        str(tmp_path), target_size=8)
+    meta = c.create_batches(seed=1)
+    assert set(meta["label_set"]) == {"cat", "dog"}
+    assert meta["mean"].shape[-2:] == (8, 8)
+    b = pickle.load(open(meta["batches"]["train"][0], "rb"))
+    assert b["data"].shape[1:] == (3, 8, 8)
+    assert b["labels"].dtype == np.int64
+
+
+def test_trainer_and_proto_namespaces():
+    # reference import paths: paddle.trainer.PyDataProvider2 / config_parser
+    # and paddle.proto
+    from paddle_tpu.trainer.PyDataProvider2 import (provider, integer_value,
+                                                    dense_vector)
+    from paddle_tpu.trainer import config_parser
+    from paddle_tpu.proto import ModelConfig_pb2
+    from paddle_tpu.v1 import layers as v1
+
+    @provider(input_types={"x": dense_vector(4),
+                           "y": integer_value(2)})
+    def reader(settings, filename):
+        yield {"x": [0.0] * 4, "y": 1}
+
+    def cfg():
+        x = v1.data_layer("nsx", size=4)
+        v1.fc_layer(x, size=2)
+
+    pc = config_parser.parse_config(cfg)
+    blob = pc.SerializeToString()
+    assert blob and pc.model_config is fluid.default_main_program()
+    assert hasattr(ModelConfig_pb2, "ProgramDesc") or \
+        hasattr(ModelConfig_pb2, "DESCRIPTOR")
