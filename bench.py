@@ -26,6 +26,7 @@ BENCH_LSTM_BS, BENCH_INFER_BS, BENCH_DTYPE, BENCH_ITERS, BENCH_LAYOUT
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -272,9 +273,22 @@ def main():
 
     results = {}
     for name in ("resnet", "lstm", "infer"):
-        fluid.reset()  # fresh default program/scope per mode
+        # each mode runs in its own PROCESS: co-resident executables and
+        # donated state from earlier modes measurably slow later ones
+        # (combined-run bs16 inference loses ~40% vs standalone), so a
+        # clean device per mode is the honest measurement
         try:
-            results[name] = runners[name](warmup, iters)
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "BENCH_MODEL": name},
+                capture_output=True, text=True, timeout=1200)
+            lines = [l for l in out.stdout.strip().splitlines()
+                     if l.startswith("{")]
+            if not lines:
+                raise RuntimeError(
+                    f"mode subprocess rc={out.returncode}: "
+                    f"{out.stderr.strip()[-400:]}")
+            results[name] = json.loads(lines[-1])
         except Exception as e:  # one broken mode must not hide the others;
             # keep the documented key set so parsers see a recognizable zero
             results[name] = {"metric": name, "value": 0.0, "unit": "error",

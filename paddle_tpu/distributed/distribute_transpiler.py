@@ -226,13 +226,13 @@ class RemoteUpdater:
                 by_param[pname] = np.asarray(grads[pname])
             elif gname in grads:
                 by_param[pname] = np.asarray(grads[gname])
-        stray = set(grads) - known
-        if known and (stray or not by_param):
+        # unrecognized extras are filtered (callers may pass every fetched
+        # @GRAD); but a push where NOTHING matched would still consume a
+        # BSP round and silently train nothing — reject that
+        if known and not by_param:
             raise KeyError(
-                f"step() grads keys {sorted(stray) or sorted(grads)} match "
-                f"no transpiled param/grad name (expected any of "
-                f"{sorted(known)}) — an empty push would still consume a "
-                f"BSP round and silently train nothing")
+                f"step() grads keys {sorted(grads)} match no transpiled "
+                f"param/grad name (expected any of {sorted(known)})")
         self.client.send_grads(by_param)
         self.pull_params()
 

@@ -29,14 +29,18 @@ def linear_chain_crf(ctx, ins, attrs):
     import jax
     import jax.numpy as jnp
 
-    emission = ins["Emission"][0].astype(jnp.float32)
-    transition = ins["Transition"][0].astype(jnp.float32)
+    # keep float64 traces intact (the numeric-grad harness runs x64);
+    # everything lower-precision computes in f32
+    fdt = jnp.float64 if ins["Emission"][0].dtype == jnp.float64 \
+        else jnp.float32
+    emission = ins["Emission"][0].astype(fdt)
+    transition = ins["Transition"][0].astype(fdt)
     label = ins["Label"][0]
     lengths = ins["Length"][0]
     B, T, C = emission.shape
     start_w, end_w, trans = _split_transition(transition)
     lab = label.reshape(B, T).astype(jnp.int32)
-    tmask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+    tmask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(fdt)
 
     # ---- log Z by forward algorithm ----
     alpha0 = start_w[None, :] + emission[:, 0]  # [B,C]

@@ -448,7 +448,8 @@ def cross_entropy_over_beam(ctx, ins, attrs):
         x = x[..., 0]
     ids = ins["Ids"][0].astype(jnp.int32)
     gold = ins["Label"][0].reshape(-1).astype(jnp.int32)
-    sel = jnp.take_along_axis(x.astype(jnp.float32), ids, axis=1)  # [B,K]
+    fdt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    sel = jnp.take_along_axis(x.astype(fdt), ids, axis=1)  # [B,K]
     valid = jnp.ones(ids.shape, bool)
     if ins.get("Length") and ins["Length"][0] is not None:
         lengths = ins["Length"][0].reshape(-1).astype(jnp.int32)
@@ -460,6 +461,6 @@ def cross_entropy_over_beam(ctx, ins, attrs):
     hit = (ids == gold[:, None]) & valid  # [B,K]
     in_beam = jnp.any(hit, axis=1)
     gold_logp = jnp.sum(jnp.where(hit, logp, 0.0), axis=1)
-    floor = jnp.log(jnp.asarray(1e-10, jnp.float32))
+    floor = jnp.log(jnp.asarray(1e-10, fdt))
     loss = jnp.where(in_beam, -gold_logp, -floor)
     return {"Out": [loss.reshape(-1, 1)]}

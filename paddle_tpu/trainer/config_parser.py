@@ -10,6 +10,7 @@ config_arg string, builds it, and returns an object exposing the same
 from __future__ import annotations
 
 import importlib
+import inspect
 import runpy
 
 from ..framework import proto_io
@@ -32,12 +33,13 @@ def parse_config(config, config_arg_str=""):
     kwargs for callables taking them (reference passed it via
     get_config_arg)."""
     if callable(config):
-        try:
-            config()
-        except TypeError:
-            kwargs = dict(kv.split("=", 1) for kv in
-                          config_arg_str.split(",") if "=" in kv)
-            config(**kwargs)
+        kwargs = dict(kv.split("=", 1) for kv in
+                      config_arg_str.split(",") if "=" in kv)
+        params = inspect.signature(config).parameters
+        accepted = {k: v for k, v in kwargs.items() if k in params} \
+            if not any(p.kind == inspect.Parameter.VAR_KEYWORD
+                       for p in params.values()) else kwargs
+        config(**accepted)
     elif isinstance(config, str):
         if config.endswith(".py"):
             runpy.run_path(config)

@@ -34,11 +34,18 @@ def state_dict_to_arrays(state_dict, name_map=None, transpose_linear=True):
 def torch_state_to_scope(state_dict, scope=None, name_map=None,
                          transpose_linear=True, strict=True):
     """Write converted arrays into the scope; with strict=True every
-    target name must already exist (shape-checked)."""
+    target name must already exist.  The transpose decision is made per
+    target against the SCOPE shape (not the name heuristic): an embedding
+    table ([V, D] both sides) passes through, a Linear weight ([out, in]
+    torch vs [in, out] fc) transposes; for square 2-D weights — where
+    shapes cannot disambiguate — `transpose_linear` + the 'weight' name
+    suffix decide."""
     from ..framework.scope import global_scope
 
     scope = scope or global_scope()
-    arrays = state_dict_to_arrays(state_dict, name_map, transpose_linear)
+    arrays = state_dict_to_arrays(state_dict, name_map,
+                                  transpose_linear=False)
+    tname_of = {(name_map or {}).get(t, t): t for t in state_dict}
     for name, arr in arrays.items():
         cur = scope.find_np(name)
         if cur is None:
@@ -47,9 +54,16 @@ def torch_state_to_scope(state_dict, scope=None, name_map=None,
                     f"target parameter {name!r} not found in scope (run "
                     f"the startup program first, or pass name_map)")
             continue
+        if arr.ndim == 2 and tuple(cur.shape) != tuple(arr.shape) \
+                and tuple(cur.shape) == tuple(arr.T.shape):
+            arr = np.ascontiguousarray(arr.T)
+        elif (arr.ndim == 2 and arr.shape[0] == arr.shape[1]
+              and transpose_linear
+              and tname_of.get(name, "").endswith("weight")):
+            arr = np.ascontiguousarray(arr.T)
         if tuple(cur.shape) != tuple(arr.shape):
             raise ValueError(
                 f"shape mismatch for {name!r}: scope {cur.shape} vs "
-                f"torch {arr.shape} (transpose_linear={transpose_linear})")
+                f"torch {arr.shape} (neither orientation fits)")
         scope.set(name, arr.astype(cur.dtype, copy=False))
     return sorted(arrays)

@@ -337,3 +337,137 @@ def test_moe_grad():
     OpTestHarness("moe", {"X": x, "Gate": gate, "WI": wi, "WO": wo},
                   {"capacity_factor": 4.0}).check_grad(
         ["X", "Gate", "WI", "WO"], max_relative_error=1e-2)
+
+
+# ---------------------------------------------------- round-3 additions:
+# the remaining diffable ops without a numeric check (toward the
+# reference's 119-op-test breadth)
+
+def test_linear_chain_crf_grad():
+    B, T, C = 2, 4, 3
+    em = _r(B, T, C, lo=-0.5, hi=0.5)
+    trans = _r(C + 2, C, lo=-0.3, hi=0.3)
+    label = RNG.randint(0, C, (B, T, 1)).astype(np.int64)
+    length = np.array([4, 3], np.int64)
+    OpTestHarness(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": trans, "Label": label,
+         "Length": length},
+        out_slots=["LogLikelihood", "Alpha"],
+    ).check_grad(["Emission", "Transition"], output_slot="LogLikelihood")
+
+
+def test_nce_grad():
+    B, D, C = 3, 4, 6
+    OpTestHarness(
+        "nce",
+        {"Input": _r(B, D), "Weight": _r(C, D), "Bias": _r(C),
+         "Label": RNG.randint(0, C, (B, 1)).astype(np.int64)},
+        {"num_total_classes": C, "num_neg_samples": 3},
+        out_slots=["Cost"],
+    ).check_grad(["Input", "Weight", "Bias"], output_slot="Cost")
+
+
+def test_multibox_loss_grad():
+    N, P, G, K = 1, 6, 2, 3
+    prior = np.stack([
+        np.linspace(0.0, 0.6, P), np.linspace(0.0, 0.6, P),
+        np.linspace(0.3, 0.9, P), np.linspace(0.3, 0.9, P)], 1)
+    OpTestHarness(
+        "multibox_loss",
+        {"Loc": _r(N, P, 4, lo=-0.2, hi=0.2),
+         "Conf": _r(N, P, K, lo=-0.5, hi=0.5),
+         "PriorBox": prior, "PriorBoxVar": np.full((P, 4), 0.1),
+         "GtBox": np.array([[[0.1, 0.1, 0.4, 0.4],
+                             [0.5, 0.5, 0.8, 0.8]]], np.float64),
+         "GtLabel": np.array([[1, 2]], np.int64),
+         "GtCount": np.array([2], np.int64)},
+        {"overlap_threshold": 0.3, "neg_pos_ratio": 1.0},
+        out_slots=["Loss"],
+    ).check_grad(["Loc", "Conf"], output_slot="Loss")
+
+
+def test_lambda_rank_grad():
+    B, T = 2, 5
+    OpTestHarness(
+        "lambda_rank",
+        {"X": _r(B, T, lo=-1, hi=1),
+         "Score": RNG.randint(0, 3, (B, T)).astype(np.float64),
+         "Length": np.array([5, 4], np.int64)},
+        {"NDCG_num": 3},
+    ).check_grad(["X"])
+
+
+def test_cross_entropy_selfnorm_and_huber_classification_grad():
+    B, C = 3, 4
+    x = _r(B, C, lo=0.2, hi=1.5)  # positive unnormalized scores
+    lab = RNG.randint(0, C, (B, 1)).astype(np.int64)
+    OpTestHarness("cross_entropy_selfnorm", {"X": x, "Label": lab},
+                  {"softmax_selfnorm_alpha": 0.2}).check_grad(["X"])
+
+    f = _away_from(_r(B, 1, lo=-2, hi=2), [-1.0, 1.0])
+    y = RNG.randint(0, 2, (B, 1)).astype(np.float64)
+    OpTestHarness("huber_classification",
+                  {"X": f, "Label": y}).check_grad(["X"])
+
+
+def test_scaled_dot_product_attention_grad():
+    B, H, T, D = 1, 2, 3, 4
+    OpTestHarness(
+        "scaled_dot_product_attention",
+        {"Q": _r(B, H, T, D), "K": _r(B, H, T, D), "V": _r(B, H, T, D)},
+        {"causal": True},
+    ).check_grad(["Q", "K", "V"])
+
+
+def test_sequence_concat_grads():
+    OpTestHarness("sequence_concat",
+                  {"X": [_r(2, 3), _r(2, 4)]}).check_grad(["X"])
+    OpTestHarness(
+        "sequence_concat_time",
+        {"X": [_r(2, 3, 2), _r(2, 2, 2)],
+         "Length": [np.array([3, 2], np.int64),
+                    np.array([2, 1], np.int64)]},
+    ).check_grad(["X"])
+
+
+def test_select_and_beam_gather_and_reduce_grads():
+    mask = np.array([[1.0], [0.0], [1.0]])
+    OpTestHarness("select", {"Mask": mask, "X": _r(3, 4), "Y": _r(3, 4)}
+                  ).check_grad(["X", "Y"])
+    OpTestHarness(
+        "beam_gather",
+        {"X": _r(2, 3, 4),
+         "Index": RNG.randint(0, 3, (2, 3)).astype(np.int64)},
+    ).check_grad(["X"])
+    x = _r(2, 5, lo=0.3, hi=1.2)  # distinct magnitudes: unique min
+    x += np.arange(10).reshape(2, 5) * 0.05
+    OpTestHarness("reduce_min", {"X": x}, {"dim": 1}).check_grad(["X"])
+    OpTestHarness("reduce_prod", {"X": x}, {"dim": 1}).check_grad(["X"])
+
+
+def test_scale_sub_region_and_pool3d_index_grad():
+    x = _r(1, 2, 3, 3)
+    idx = np.array([[1, 1, 1, 2, 1, 2]], np.float64)  # 1-based box
+    OpTestHarness("scale_sub_region", {"X": x, "Indices": idx},
+                  {"value": 2.0}).check_grad(["X"])
+    x3 = _r(1, 1, 4, 4, 4)
+    x3 += np.arange(x3.size).reshape(x3.shape) * 0.01  # unique maxima
+    OpTestHarness(
+        "max_pool3d_with_index", {"X": x3},
+        {"ksize": [2, 2, 2], "strides": [2, 2, 2]},
+        out_slots=["Out", "Mask"],
+    ).check_grad(["X"], output_slot="Out")
+
+
+def test_cross_entropy_over_beam_grad():
+    B, T, K = 2, 5, 3
+    x = _r(B, T, lo=-1, hi=1)
+    ids = np.stack([RNG.choice(T, K, replace=False) for _ in range(B)]
+                   ).astype(np.int64)
+    gold = ids[:, 0].reshape(B, 1)  # gold guaranteed in-beam
+    OpTestHarness(
+        "cross_entropy_over_beam",
+        {"X": x, "Ids": ids, "Label": gold,
+         "Length": np.full(B, T, np.int64)},
+    ).check_grad(["X"])

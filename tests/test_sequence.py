@@ -127,3 +127,44 @@ def test_sequence_conv_sentiment():
             fetch_list=[loss])
         losses.append(float(l.item()))
     assert losses[-1] < losses[0]
+
+
+def test_understand_sentiment_static_lstm_unit():
+    """The third reference sentiment variant (book
+    test_understand_sentiment_lstm.py): lstm_unit steps inside a StaticRNN
+    over the padded sequence — exercises the fluid lstm_unit wrapper in
+    the recurrent machinery."""
+    H = 24
+    words = fluid.layers.sequence_data(name="words", shape=[1],
+                                       dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[100, 16])
+    lengths = fluid.layers.get_length_var(emb)
+    rnn = fluid.layers.StaticRNN(lengths=lengths)
+    with rnn.step():
+        x_t = rnn.step_input(emb)
+        h_prev = rnn.memory(shape=[H], batch_ref=emb)
+        c_prev = rnn.memory(shape=[H], batch_ref=emb)
+        h, c = fluid.layers.lstm_unit(x_t, h_prev, c_prev, forget_bias=1.0)
+        rnn.update_memory(h_prev, h)
+        rnn.update_memory(c_prev, c)
+        rnn.step_output(h)
+    hidden = rnn()
+    fluid.layers.propagate_length(emb, hidden)
+    pooled = fluid.layers.sequence_pool(hidden, pool_type="last")
+    logits = fluid.layers.fc(input=pooled, size=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs, labels = _sentiment_data()
+    accs = []
+    for _ in range(20):
+        _, a = exe.run(
+            feed={"words": LoDTensor.from_sequences(seqs), "label": labels},
+            fetch_list=[loss, acc])
+        accs.append(float(a.item()))
+    assert accs[-1] > 0.9, accs

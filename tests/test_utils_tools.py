@@ -99,3 +99,16 @@ def test_trainer_and_proto_namespaces():
     assert blob and pc.model_config is fluid.default_main_program()
     assert hasattr(ModelConfig_pb2, "ProgramDesc") or \
         hasattr(ModelConfig_pb2, "DESCRIPTOR")
+
+
+def test_torch2paddle_embedding_not_transposed():
+    torch = pytest.importorskip("torch")
+    emb = layers.data("t2pe", shape=[1], dtype="int64")
+    out = layers.embedding(emb, size=[7, 3], param_attr={"name": "t2p_emb"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    table = torch.nn.Embedding(7, 3)
+    utils.torch2paddle.torch_state_to_scope(
+        table.state_dict(), name_map={"weight": "t2p_emb"})
+    np.testing.assert_allclose(fluid.global_scope().find_np("t2p_emb"),
+                               table.weight.detach().numpy(), rtol=1e-6)
