@@ -239,7 +239,8 @@ def bench_lstm_train(warmup, iters):
 def main():
     import paddle_tpu as fluid
 
-    model = os.environ.get("BENCH_MODEL", "all")
+    model = os.environ.get("BENCH_CHILD_MODE") \
+        or os.environ.get("BENCH_MODEL", "all")
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
 
@@ -280,7 +281,7 @@ def main():
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env={**os.environ, "BENCH_MODEL": name},
+                env={**os.environ, "BENCH_CHILD_MODE": name},
                 capture_output=True, text=True, timeout=1200)
             lines = [l for l in out.stdout.strip().splitlines()
                      if l.startswith("{")]

@@ -227,8 +227,16 @@ class RemoteUpdater:
             elif gname in grads:
                 by_param[pname] = np.asarray(grads[gname])
         # unrecognized extras are filtered (callers may pass every fetched
-        # @GRAD); but a push where NOTHING matched would still consume a
-        # BSP round and silently train nothing — reject that
+        # @GRAD) but WARNED about — a typoed grad name would otherwise
+        # leave its parameter silently untrained; a push where NOTHING
+        # matched would still consume a BSP round, reject that outright
+        stray = set(grads) - known
+        if stray:
+            import logging
+            logging.getLogger(__name__).warning(
+                "RemoteUpdater.step: ignoring grads keys %s (no matching "
+                "transpiled param/grad; expected among %s)",
+                sorted(stray), sorted(known))
         if known and not by_param:
             raise KeyError(
                 f"step() grads keys {sorted(grads)} match no transpiled "

@@ -20,6 +20,7 @@ and results; host↔HBM transfer happens only there.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -65,10 +66,41 @@ def as_numpy(x):
     return np.asarray(x)
 
 
+_cc_enabled = False
+
+
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache: repeat processes (CLI runs, CI,
+    the subprocess-isolated bench modes) reuse on-disk executables instead
+    of recompiling.  Default ON for the CPU backend only — on a tunneled
+    TPU backend serializing a multi-hundred-MB executable rides the
+    tunnel, an unbounded cost — so TPU opts in via
+    PADDLE_TPU_COMPILE_CACHE=<dir>.  PADDLE_TPU_NO_COMPILE_CACHE=1
+    disables entirely."""
+    global _cc_enabled
+    if _cc_enabled or os.environ.get("PADDLE_TPU_NO_COMPILE_CACHE"):
+        return
+    _cc_enabled = True
+    try:
+        import jax
+
+        explicit = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+        if not explicit and jax.default_backend() != "cpu":
+            return
+        path = explicit or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # cache is an optimization, never a failure
+        pass
+
+
 class Executor:
     """fluid.Executor equivalent (python executor.py:70 / pybind.cc:424)."""
 
     def __init__(self, place: Optional[Place] = None):
+        _enable_compilation_cache()
         self.place = place if place is not None else default_place()
         self._cache: Dict[tuple, _Compiled] = {}
         self._load_paths: Dict[tuple, tuple] = {}

@@ -54,7 +54,8 @@ def torch_state_to_scope(state_dict, scope=None, name_map=None,
                     f"target parameter {name!r} not found in scope (run "
                     f"the startup program first, or pass name_map)")
             continue
-        if arr.ndim == 2 and tuple(cur.shape) != tuple(arr.shape) \
+        if transpose_linear and arr.ndim == 2 \
+                and tuple(cur.shape) != tuple(arr.shape) \
                 and tuple(cur.shape) == tuple(arr.T.shape):
             arr = np.ascontiguousarray(arr.T)
         elif (arr.ndim == 2 and arr.shape[0] == arr.shape[1]
@@ -64,6 +65,7 @@ def torch_state_to_scope(state_dict, scope=None, name_map=None,
         if tuple(cur.shape) != tuple(arr.shape):
             raise ValueError(
                 f"shape mismatch for {name!r}: scope {cur.shape} vs "
-                f"torch {arr.shape} (neither orientation fits)")
+                f"torch {arr.shape} "
+                f"(transpose_linear={transpose_linear})")
         scope.set(name, arr.astype(cur.dtype, copy=False))
     return sorted(arrays)
