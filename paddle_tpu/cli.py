@@ -10,6 +10,7 @@ Usage: python -m paddle_tpu <subcommand> [args]
   stats DIR|FILE        — one JSON line of program stats (native lib)
   merge_model DIR OUT   — bundle a saved inference model into one file
   validate DIR|FILE     — structural check via the native desc library
+  show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
 """
 
@@ -120,6 +121,13 @@ def cmd_validate(args) -> int:
     return 1
 
 
+def cmd_show_pb(args) -> int:
+    from .utils import show_pb
+
+    show_pb.dump_program(_model_bytes(args.model))
+    return 0
+
+
 def cmd_merge_model(args) -> int:
     from . import io
 
@@ -157,7 +165,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_train)
 
     for name, fn in (("dump_config", cmd_dump_config), ("stats", cmd_stats),
-                     ("validate", cmd_validate)):
+                     ("validate", cmd_validate), ("show_pb", cmd_show_pb)):
         p = sub.add_parser(name)
         p.add_argument("model", help="saved model dir or __model__ file")
         p.set_defaults(fn=fn)

@@ -118,3 +118,10 @@ def test_train_config_flow(tmp_path, capsys):
         assert rec["job"] == "time" and rec["ms_per_batch"] > 0
     finally:
         reset_data_sources()
+
+
+def test_cli_show_pb(tmp_path, capsys):
+    d, _ = _saved_model(tmp_path)
+    assert cli.main(["show_pb", d]) == 0
+    out = capsys.readouterr().out
+    assert "op mul" in out and "var x" in out
