@@ -120,9 +120,15 @@ class OpTestHarness:
                            fetch_list=[grad_map[n] for n in check_names])
 
         # numeric: forward-only program built once, executable cached across
-        # perturbations (only scope values change)
-        fprog, _, fouts = self._build()
+        # perturbations (only scope values change).  Backward ops are
+        # appended (their results unfetched — XLA prunes them) so the
+        # executor's is_test inference sees a TRAINING program: ops whose
+        # emitters branch on ctx.is_test (dropout, batch_norm) must run in
+        # the same mode as the analytic program or the numeric gradient
+        # measures a different function.
+        fprog, _, fouts = self._build(trainable_slots=tuple(inputs_to_check))
         floss = fluid.layers.mean(fouts[output_slot])
+        fluid.append_backward(floss)
         fexe = fluid.Executor(fluid.CPUPlace())
         fscope = fluid.global_scope()
 

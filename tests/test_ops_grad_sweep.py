@@ -471,3 +471,14 @@ def test_cross_entropy_over_beam_grad():
         {"X": x, "Ids": ids, "Label": gold,
          "Length": np.full(B, T, np.int64)},
     ).check_grad(["X"])
+
+
+def test_dropout_grad_deterministic_rng():
+    # the harness pins exe._step, so the dropout mask is identical across
+    # the analytic run and every numeric perturbation — the grad is exact
+    x = _r(4, 6, lo=0.5, hi=1.5)
+    OpTestHarness("dropout", {"X": x},
+                  {"dropout_prob": 0.4,
+                   "dropout_implementation": "upscale_in_train"},
+                  out_slots=["Out", "Mask"]).check_grad(
+        ["X"], output_slot="Out")
