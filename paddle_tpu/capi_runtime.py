@@ -26,8 +26,26 @@ _engines: Dict[int, "_Engine"] = {}
 _next_handle = 1
 
 
+def _apply_platform_env():
+    """Honor JAX_PLATFORMS before the first backend init: the embedded
+    interpreter may carry a site hook that pins an accelerator platform,
+    and a C deployment asking for CPU must not block on (or wait for) a
+    tunneled accelerator it never uses."""
+    import os
+
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plats)
+        except Exception:  # backends already initialized — leave them be
+            pass
+
+
 class _Engine:
     def __init__(self, model_dir: str):
+        _apply_platform_env()
         import paddle_tpu as fluid
 
         self.scope = fluid.Scope()

@@ -204,6 +204,11 @@ class ParameterServerService:
         self._contributed: set = set()
         self._pass_cv = threading.Condition(self._lock)
         self._pass_waiting = 0
+        self._pass_arrived = set()
+        self._pass_pending_seq: Dict[str, object] = {}
+        self._pass_seq: Dict[str, object] = {}
+        self._grad_seq: Dict[str, object] = {}
+        self._sparse_seq: Dict[str, object] = {}
         self._pass_no = 0
 
     # -- init barrier (service.go:229/260: trainer 0 seeds params) ----------
@@ -226,19 +231,42 @@ class ParameterServerService:
 
     # -- gradient path (service.go:285 SendGrad / PS2.cpp:362 addGradient) --
     def send_grad(self, trainer_id: str, grads: Dict[str, np.ndarray],
-                  timeout: Optional[float] = 120.0):
+                  timeout: Optional[float] = 120.0, seq=None):
+        """`seq` is the client's per-connection monotonic id: a transport
+        retry of a request the server already processed (reply lost) must
+        not double-apply the gradient or double-count the BSP round."""
         with self._round_cv:
             if not self._init_done:
                 raise RuntimeError("send_grad before FinishInitParams")
+            duplicate = (seq is not None
+                         and self._grad_seq.get(trainer_id) == seq)
             if self.mode == "async":
+                if duplicate:
+                    return
                 for name, g in grads.items():
                     self._params[name] = self._opts[name].update(
                         self._params[name], np.asarray(g))
+                if seq is not None:
+                    self._grad_seq[trainer_id] = seq
+                return
+            if duplicate:
+                # already accumulated; if its round is still open, wait for
+                # it like the original call would, else it completed
+                if trainer_id in self._contributed:
+                    my_round = self._round
+                    if not self._round_cv.wait_for(
+                            lambda: self._round > my_round,
+                            timeout=timeout):
+                        raise TimeoutError(
+                            f"BSP round {my_round}: peers missing after "
+                            f"{timeout}s")
                 return
             for name, g in grads.items():
                 g = np.asarray(g)
                 self._acc[name] = self._acc.get(name, 0) + g
             self._contributed.add(trainer_id)
+            if seq is not None:
+                self._grad_seq[trainer_id] = seq
             my_round = self._round
             if len(self._contributed) >= self.num_trainers:
                 for name, total in self._acc.items():
@@ -258,16 +286,21 @@ class ParameterServerService:
                         f"{timeout}s")
 
     def send_sparse_grad(self, trainer_id: str, name: str,
-                         rows: np.ndarray, values: np.ndarray):
+                         rows: np.ndarray, values: np.ndarray, seq=None):
         """SelectedRows gradient: update only `rows` of the table (sparse
         pserver path — RemoteParameterUpdater.h:265, SparseRowMatrix).
         Always applied immediately (async), matching the reference's
-        sparse-remote behavior of row-level updates."""
+        sparse-remote behavior of row-level updates.  `seq` dedups
+        transport retries (see send_grad)."""
         with self._lock:
             if not self._init_done:
                 raise RuntimeError("send_grad before FinishInitParams")
+            if seq is not None and self._sparse_seq.get(trainer_id) == seq:
+                return
             self._params[name] = self._opts[name].update_rows(
                 self._params[name], np.asarray(rows), np.asarray(values))
+            if seq is not None:
+                self._sparse_seq[trainer_id] = seq
 
     # -- fetch (service.go:311 GetParam / PS2.cpp:559 getParameter) ---------
     def get_param(self, name: str) -> np.ndarray:
@@ -284,12 +317,29 @@ class ParameterServerService:
             return sorted(self._params)
 
     # -- pass barriers (PS2 waitPassStart/waitPassFinish) -------------------
-    def wait_pass_barrier(self, timeout: Optional[float] = 120.0) -> int:
-        """All trainers rendezvous; returns the new pass number."""
+    def wait_pass_barrier(self, timeout: Optional[float] = 120.0,
+                          trainer_id: str = "", seq=None) -> int:
+        """All trainers rendezvous; returns the new pass number.  `seq` is
+        the client's retry token: a retry of a call whose barrier already
+        RELEASED (reply lost) returns immediately instead of counting as a
+        fresh arrival for the next pass; a re-arrival while the barrier is
+        still open counts once.  Anonymous callers keep plain counting."""
         with self._pass_cv:
-            self._pass_waiting += 1
+            if trainer_id and seq is not None \
+                    and self._pass_seq.get(trainer_id) == seq:
+                return self._pass_no  # completed-call retry
+            if trainer_id:
+                if trainer_id not in self._pass_arrived:
+                    self._pass_arrived.add(trainer_id)
+                    self._pass_pending_seq[trainer_id] = seq
+                    self._pass_waiting += 1
+            else:
+                self._pass_waiting += 1
             if self._pass_waiting >= self.num_trainers:
                 self._pass_waiting = 0
+                self._pass_seq.update(self._pass_pending_seq)
+                self._pass_pending_seq = {}
+                self._pass_arrived = set()
                 self._pass_no += 1
                 self._pass_cv.notify_all()
                 return self._pass_no
@@ -432,7 +482,8 @@ class _PServerHandler(socketserver.BaseRequestHandler):
                 n = int(np.prod(d["shape"])) * np.dtype(d["dtype"]).itemsize
                 grads[d["name"]] = _unpack_array(d, payload[off:off + n])
                 off += n
-            svc.send_grad(header["trainer_id"], grads)
+            svc.send_grad(header["trainer_id"], grads,
+                          seq=header.get("seq"))
             return {"ok": True}, b""
         if op == "send_sparse_grad":
             rd, vd = header["rows"], header["values"]
@@ -440,7 +491,7 @@ class _PServerHandler(socketserver.BaseRequestHandler):
             rows = _unpack_array(rd, payload[:rn])
             values = _unpack_array(vd, payload[rn:])
             svc.send_sparse_grad(header["trainer_id"], header["name"],
-                                 rows, values)
+                                 rows, values, seq=header.get("seq"))
             return {"ok": True}, b""
         if op == "get_param":
             desc, out = _pack_array(svc.get_param(header["name"]))
@@ -452,7 +503,9 @@ class _PServerHandler(socketserver.BaseRequestHandler):
         if op == "param_names":
             return {"ok": True, "value": svc.param_names()}, b""
         if op == "pass_barrier":
-            return {"ok": True, "value": svc.wait_pass_barrier()}, b""
+            return {"ok": True, "value": svc.wait_pass_barrier(
+                trainer_id=header.get("trainer_id", ""),
+                seq=header.get("seq"))}, b""
         if op == "save_checkpoint":
             return {"ok": True,
                     "value": svc.save_checkpoint(header.get("dir"))}, b""
@@ -465,6 +518,8 @@ class PServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, host="127.0.0.1", port=0, num_trainers=1, mode="bsp",
                  checkpoint_dir=None):
+        self._live_requests: set = set()
+        self._live_lock = threading.Lock()
         super().__init__((host, port), _PServerHandler)
         self.service = ParameterServerService(
             num_trainers=num_trainers, mode=mode,
@@ -483,8 +538,33 @@ class PServer(socketserver.ThreadingTCPServer):
         self._thread.start()
         return self
 
+    # track accepted sockets so stop() can SEVER live trainer connections —
+    # a "stopped" server whose handler threads keep serving would make
+    # fault-injection tests (and real failover) silently talk to the corpse
+    def process_request(self, request, client_address):
+        with self._live_lock:
+            self._live_requests.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live_requests.discard(request)
+        super().shutdown_request(request)
+
     def stop(self):
         self.shutdown()
+        with self._live_lock:
+            live = list(self._live_requests)
+            self._live_requests.clear()
+        for r in live:
+            try:
+                r.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                r.close()
+            except OSError:
+                pass
         self.server_close()
 
 
@@ -505,6 +585,17 @@ class ParameterClient:
         self.endpoints = list(endpoints)
         self.trainer_id = trainer_id
         self._socks: Dict[str, socket.socket] = {}
+        # retry-dedup tokens: a fresh nonce per client instance means a
+        # RESTARTED trainer (same trainer_id, new process) can never match
+        # a stale server-side entry and lose its first gradient
+        import uuid
+
+        self._nonce = uuid.uuid4().hex[:12]
+        self._seq = 0
+
+    def _next_seq(self) -> str:
+        self._seq += 1
+        return f"{self._nonce}:{self._seq}"
 
     def _sock(self, endpoint: str) -> socket.socket:
         if endpoint not in self._socks:
@@ -516,13 +607,36 @@ class ParameterClient:
     def _server_for(self, name: str) -> str:
         return server_for(name, self.endpoints)
 
-    def _call(self, endpoint, header, payload=b""):
-        sock = self._sock(endpoint)
-        _send_msg(sock, header, payload)
-        reply, out = _recv_msg(sock)
-        if not reply.get("ok"):
-            raise RuntimeError(reply.get("error", "pserver error"))
-        return reply, out
+    def _call(self, endpoint, header, payload=b"", retries: int = 8,
+              backoff_s: float = 0.25):
+        """One RPC with reconnect-on-error: a pserver restart (the elastic
+        story — SURVEY §3.4 'pserver death → trainer reconnects; pserver
+        restart → checkpoint reload') shows up here as a broken socket;
+        drop it, back off, redial.  Service errors (ok=False) raise
+        immediately — only transport failures retry."""
+        last = None
+        for attempt in range(retries):
+            try:
+                sock = self._sock(endpoint)
+                _send_msg(sock, header, payload)
+                reply, out = _recv_msg(sock)
+            except (OSError, ConnectionError) as e:
+                last = e
+                dead = self._socks.pop(endpoint, None)
+                if dead is not None:
+                    try:
+                        dead.close()
+                    except OSError:
+                        pass
+                if attempt + 1 < retries:
+                    time.sleep(backoff_s * (attempt + 1))
+                continue
+            if not reply.get("ok"):
+                raise RuntimeError(reply.get("error", "pserver error"))
+            return reply, out
+        raise ConnectionError(
+            f"pserver {endpoint} unreachable after {retries} attempts: "
+            f"{last}")
 
     # paddle_begin_init_params / paddle_init_param / finish (cclient.go)
     def init_param(self, name, value, optimizer=None):
@@ -555,6 +669,7 @@ class ParameterClient:
                 chunks.append(b)
             self._call(ep, {"op": "send_grad",
                             "trainer_id": self.trainer_id,
+                            "seq": self._next_seq(),
                             "arrays": descs}, b"".join(chunks))
 
     def send_sparse_grad(self, name, rows, values):
@@ -562,6 +677,7 @@ class ParameterClient:
         vd, vb = _pack_array(np.asarray(values))
         self._call(self._server_for(name),
                    {"op": "send_sparse_grad", "trainer_id": self.trainer_id,
+                    "seq": self._next_seq(),
                     "name": name, "rows": rd, "values": vd}, rb + vb)
 
     def get_param(self, name) -> np.ndarray:
@@ -586,7 +702,9 @@ class ParameterClient:
         return out
 
     def pass_barrier(self) -> int:
-        vals = [self._call(ep, {"op": "pass_barrier"})[0]["value"]
+        vals = [self._call(ep, {"op": "pass_barrier",
+                                "trainer_id": self.trainer_id,
+                                "seq": self._next_seq()})[0]["value"]
                 for ep in self.endpoints]
         return max(vals)
 

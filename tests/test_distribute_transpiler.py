@@ -123,3 +123,58 @@ def test_updater_rejects_mismatched_trainers_and_stray_grads():
         upd.close()
     finally:
         srv.stop()
+
+
+def test_elastic_pserver_restart_mid_training(tmp_path):
+    """Fault injection (SURVEY §3.4 failure row: 'pserver death -> trainer
+    reconnects; pserver restart -> checkpoint reload'): kill the pserver
+    mid-training, restart it on the same endpoint from its checkpoint, and
+    the SAME client object keeps training through the outage."""
+    rng = np.random.RandomState(1)
+    x = layers.data("elx", shape=[4], dtype="float32")
+    y = layers.data("ely", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+
+    ckpt = str(tmp_path)
+    srv = PServer(port=0, num_trainers=1, checkpoint_dir=ckpt)
+    srv.start()
+    port = srv.server_address[1]  # restart rebinds this exact port
+    ep = f"127.0.0.1:{port}"
+    t = fluid.DistributeTranspiler().transpile(0, pservers=ep, trainers=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    upd = t.make_updater()
+    upd.init_params()
+    W = np.array([[1.0], [-1.0], [2.0], [0.5]], np.float32)
+    gvars = t.grad_fetch_list()
+    gnames = [g.name for g in gvars]
+
+    def steps(n):
+        out = []
+        for _ in range(n):
+            xv = rng.rand(16, 4).astype(np.float32)
+            yv = xv @ W
+            res = exe.run(feed={"elx": xv, "ely": yv},
+                          fetch_list=[cost] + gvars)
+            out.append(float(np.asarray(res[0]).reshape(())))
+            upd.step(dict(zip(gnames, res[1:])))
+        return out
+
+    first = steps(20)
+    srv.service.save_checkpoint()
+    param_at_kill = upd.client.get_param(list(t.param_cfg)[0])
+    srv.stop()  # ---- failure ----
+
+    srv2 = PServer(port=port, num_trainers=1, checkpoint_dir=ckpt)
+    srv2.start()  # ---- elastic restart: reload checkpoint, same endpoint
+    try:
+        assert srv2.service.initialized()  # state survived the crash
+        np.testing.assert_allclose(
+            srv2.service.get_param(list(t.param_cfg)[0]), param_at_kill)
+        second = steps(20)  # same client: reconnect happens inside _call
+        assert second[-1] < first[0] * 0.5  # training continued improving
+        upd.close()
+    finally:
+        srv2.stop()

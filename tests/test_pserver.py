@@ -130,3 +130,85 @@ def test_tcp_two_servers_two_trainers(tmp_path):
     finally:
         s1.stop()
         s2.stop()
+
+
+def test_send_grad_retry_dedup():
+    """A transport retry of an already-processed send_grad (same seq) must
+    not double-apply the gradient (round-2 review finding: the reply can be
+    lost after the server applied the update)."""
+    svc = ParameterServerService(num_trainers=1, mode="bsp")
+    svc.init_param("w", np.zeros(2, np.float32), {"type": "sgd", "lr": 1.0})
+    svc.finish_init_params()
+    g = {"w": np.array([1.0, 0.0], np.float32)}
+    svc.send_grad("t0", g, seq=7)
+    svc.send_grad("t0", g, seq=7)  # retry: duplicate, no second apply
+    np.testing.assert_allclose(svc.get_param("w"), [-1.0, 0.0])
+    svc.send_grad("t0", g, seq=8)  # genuinely new round applies
+    np.testing.assert_allclose(svc.get_param("w"), [-2.0, 0.0])
+
+    # async mode too
+    svc2 = ParameterServerService(num_trainers=2, mode="async")
+    svc2.init_param("w", np.zeros(2, np.float32), {"type": "sgd", "lr": 1.0})
+    svc2.finish_init_params()
+    svc2.send_grad("t0", g, seq=1)
+    svc2.send_grad("t0", g, seq=1)
+    np.testing.assert_allclose(svc2.get_param("w"), [-1.0, 0.0])
+
+    # sparse path
+    svc3 = ParameterServerService(num_trainers=1)
+    svc3.init_param("emb", np.zeros((4, 2), np.float32),
+                    {"type": "sgd", "lr": 1.0})
+    svc3.finish_init_params()
+    rows = np.array([1]); vals = np.ones((1, 2), np.float32)
+    svc3.send_sparse_grad("t0", "emb", rows, vals, seq=3)
+    svc3.send_sparse_grad("t0", "emb", rows, vals, seq=3)
+    np.testing.assert_allclose(svc3.get_param("emb")[1], [-1.0, -1.0])
+
+
+def test_pass_barrier_identity_dedup():
+    import threading
+    svc = ParameterServerService(num_trainers=2)
+    svc.init_param("w", np.zeros(1, np.float32))
+    svc.finish_init_params()
+    results = []
+
+    def arrive(tid):
+        results.append(svc.wait_pass_barrier(timeout=10, trainer_id=tid))
+
+    # t0 arrives twice (retry) — must still require t1 before releasing
+    t_a = threading.Thread(target=arrive, args=("t0",))
+    t_b = threading.Thread(target=arrive, args=("t0",))
+    t_a.start(); t_b.start()
+    import time as _t
+    _t.sleep(0.3)
+    assert not results  # barrier must NOT have released on the duplicate
+    t_c = threading.Thread(target=arrive, args=("t1",))
+    t_c.start()
+    for th in (t_a, t_b, t_c):
+        th.join(timeout=10)
+        assert not th.is_alive()
+    assert results == [1, 1, 1]
+
+
+def test_pass_barrier_completed_retry_returns_immediately():
+    """A retry of a barrier call whose barrier already released (reply
+    lost) must NOT count toward the next pass (review finding)."""
+    import threading
+    svc = ParameterServerService(num_trainers=2)
+    svc.init_param("w", np.zeros(1, np.float32))
+    svc.finish_init_params()
+    out = []
+
+    def arrive(tid, seq):
+        out.append(svc.wait_pass_barrier(timeout=10, trainer_id=tid,
+                                         seq=seq))
+
+    a = threading.Thread(target=arrive, args=("t0", "n0:1"))
+    b = threading.Thread(target=arrive, args=("t1", "n1:1"))
+    a.start(); b.start()
+    a.join(10); b.join(10)
+    assert out == [1, 1]
+    # t0's reply was lost; its retry must return pass 1, not arm pass 2
+    assert svc.wait_pass_barrier(timeout=1, trainer_id="t0",
+                                 seq="n0:1") == 1
+    assert svc._pass_waiting == 0  # nothing armed for the next pass
