@@ -47,6 +47,8 @@ class MasterService:
         self._done: List[Task] = []
         self._epoch = 0
         self._next_id = 0
+        # per-client-nonce last (seq, reply): transport retry dedup
+        self._rpc_cache: Dict[str, tuple] = {}
         if snapshot_path and os.path.exists(snapshot_path):
             self.recover()
 
@@ -124,6 +126,22 @@ class MasterService:
             else:
                 self._done.append(t)
 
+    # -- transport retry dedup (lost-reply replays: the client retries a
+    # processed get_task and would otherwise receive a SECOND task while
+    # the first burns a timeout+failure — at-most-once per seq token) -----
+    def rpc_cached(self, seq: str):
+        nonce = str(seq).split(":", 1)[0]
+        with self._lock:
+            ent = self._rpc_cache.get(nonce)
+            if ent is not None and ent[0] == seq:
+                return ent[1]
+        return None
+
+    def rpc_record(self, seq: str, resp: dict):
+        nonce = str(seq).split(":", 1)[0]
+        with self._lock:
+            self._rpc_cache[nonce] = (seq, resp)
+
     # -- introspection ------------------------------------------------------
     def progress(self) -> dict:
         with self._lock:
@@ -190,8 +208,18 @@ class _Handler(socketserver.StreamRequestHandler):
                 req = json.loads(line)
                 method = req["method"]
                 args = req.get("args", [])
+                seq = req.get("seq")
+                if seq is not None:
+                    cached = svc.rpc_cached(seq)
+                    if cached is not None:
+                        resp = cached
+                        self.wfile.write((json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                        continue
                 result = getattr(svc, method)(*args)
                 resp = {"ok": True, "result": result}
+                if seq is not None:
+                    svc.rpc_record(seq, resp)
             except Exception as e:  # report, keep serving
                 resp = {"ok": False, "error": str(e)}
             self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -200,9 +228,9 @@ class _Handler(socketserver.StreamRequestHandler):
 
 class MasterServer:
     def __init__(self, service: MasterService, host="127.0.0.1", port=0):
-        self._srv = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
-        self._srv.daemon_threads = True
+        from .pserver import SeverableThreadingTCPServer
+
+        self._srv = SeverableThreadingTCPServer((host, port), _Handler)
         self._srv.service = service  # type: ignore
         self.addr = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,
@@ -214,6 +242,7 @@ class MasterServer:
 
     def stop(self):
         self._srv.shutdown()
+        self._srv.sever()
         self._srv.server_close()
 
 
@@ -222,10 +251,14 @@ class MasterClient:
     :28/:70) with reconnect-on-error."""
 
     def __init__(self, addr, retries: int = 3):
+        import uuid
+
         self.addr = tuple(addr)
         self.retries = retries
         self._sock = None
         self._file = None
+        self._nonce = uuid.uuid4().hex[:12]
+        self._seq = 0
 
     def _connect(self):
         self._sock = socket.create_connection(self.addr, timeout=30)
@@ -233,12 +266,15 @@ class MasterClient:
 
     def call(self, method, *args):
         last = None
+        self._seq += 1
+        seq = f"{self._nonce}:{self._seq}"  # same token on every retry
         for _ in range(self.retries):
             try:
                 if self._file is None:
                     self._connect()
                 self._file.write(
-                    (json.dumps({"method": method, "args": list(args)})
+                    (json.dumps({"method": method, "args": list(args),
+                                 "seq": seq})
                      + "\n").encode())
                 self._file.flush()
                 resp = json.loads(self._file.readline())

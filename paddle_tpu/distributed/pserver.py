@@ -372,6 +372,12 @@ class ParameterServerService:
                 "round": self._round,
                 "pass": self._pass_no,
                 "opt_cfgs": self._opt_cfgs,
+                # retry-dedup state: exactly-once must survive the restart
+                # (a reply lost across the crash is retried against the
+                # reloaded server)
+                "grad_seq": self._grad_seq,
+                "sparse_seq": self._sparse_seq,
+                "pass_seq": self._pass_seq,
             }
             with open(os.path.join(dirname, "pserver.meta.json"), "w") as f:
                 json.dump(meta, f)
@@ -405,6 +411,9 @@ class ParameterServerService:
                 self._opts[key] = opt
             self._round = int(meta.get("round", 0))
             self._pass_no = int(meta.get("pass", 0))
+            self._grad_seq = dict(meta.get("grad_seq", {}))
+            self._sparse_seq = dict(meta.get("sparse_seq", {}))
+            self._pass_seq = dict(meta.get("pass_seq", {}))
             self._init_done = True
         return True
 
@@ -512,14 +521,48 @@ class _PServerHandler(socketserver.BaseRequestHandler):
         raise RuntimeError(f"unknown op {op!r}")
 
 
-class PServer(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
+class SeverableThreadingTCPServer(socketserver.ThreadingTCPServer):
+    """ThreadingTCPServer that can SEVER live handler connections: with
+    daemon_threads, shutdown()/server_close() leave accepted sockets open
+    and the "stopped" server keeps serving — real failover (and the fault
+    injection that tests it) needs the corpse to go quiet."""
+
+    allow_reuse_address = True  # failover rebinds the same endpoint
     daemon_threads = True
 
-    def __init__(self, host="127.0.0.1", port=0, num_trainers=1, mode="bsp",
-                 checkpoint_dir=None):
+    def __init__(self, addr, handler, **kw):
         self._live_requests: set = set()
         self._live_lock = threading.Lock()
+        super().__init__(addr, handler, **kw)
+
+    def process_request(self, request, client_address):
+        with self._live_lock:
+            self._live_requests.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live_requests.discard(request)
+        super().shutdown_request(request)
+
+    def sever(self):
+        with self._live_lock:
+            live = list(self._live_requests)
+            self._live_requests.clear()
+        for r in live:
+            try:
+                r.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                r.close()
+            except OSError:
+                pass
+
+
+class PServer(SeverableThreadingTCPServer):
+    def __init__(self, host="127.0.0.1", port=0, num_trainers=1, mode="bsp",
+                 checkpoint_dir=None):
         super().__init__((host, port), _PServerHandler)
         self.service = ParameterServerService(
             num_trainers=num_trainers, mode=mode,
@@ -538,33 +581,9 @@ class PServer(socketserver.ThreadingTCPServer):
         self._thread.start()
         return self
 
-    # track accepted sockets so stop() can SEVER live trainer connections —
-    # a "stopped" server whose handler threads keep serving would make
-    # fault-injection tests (and real failover) silently talk to the corpse
-    def process_request(self, request, client_address):
-        with self._live_lock:
-            self._live_requests.add(request)
-        super().process_request(request, client_address)
-
-    def shutdown_request(self, request):
-        with self._live_lock:
-            self._live_requests.discard(request)
-        super().shutdown_request(request)
-
     def stop(self):
         self.shutdown()
-        with self._live_lock:
-            live = list(self._live_requests)
-            self._live_requests.clear()
-        for r in live:
-            try:
-                r.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                r.close()
-            except OSError:
-                pass
+        self.sever()
         self.server_close()
 
 

@@ -179,3 +179,67 @@ def test_checkpoint_integrity_detects_corruption(tmp_path):
     exe2 = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(IOError):
         load_checkpoint(exe2, ckpt_dir)
+
+
+def test_master_failover_mid_pass(tmp_path):
+    """Kill the master mid-pass and restart it from its snapshot on the
+    same endpoint: the client's reconnect (MasterClient retries) resumes
+    task pulls and every chunk is still processed exactly once per pass
+    (go master etcd snapshot/recover semantics, SURVEY §3.4)."""
+    snap = str(tmp_path / "master.snap")
+    svc = MasterService(timeout_s=30.0, snapshot_path=snap)
+    svc.set_dataset([f"chunk-{i}" for i in range(6)])
+    srv = MasterServer(svc).start()
+    host, port = srv.addr
+    c = MasterClient((host, port))
+    got = []
+    for _ in range(3):  # half the pass
+        t = c.get_task()
+        got.append(t["payload"])
+        c.task_finished(t["task_id"])
+    srv.stop()  # ---- master dies ----
+
+    svc2 = MasterService(timeout_s=30.0, snapshot_path=snap)
+    srv2 = MasterServer(svc2, host=host, port=port).start()  # same endpoint
+    try:
+        while True:  # same client object: retries redial the endpoint
+            t = c.get_task()
+            if t is None or t["epoch"] > 0:
+                break
+            got.append(t["payload"])
+            c.task_finished(t["task_id"])
+        assert sorted(got) == [f"chunk-{i}" for i in range(6)]
+    finally:
+        srv2.stop()
+
+
+def test_master_rpc_retry_dedup():
+    """A lost-reply retry of get_task must return the SAME task, not
+    dispense a second one (review finding: the duplicate would burn a
+    timeout + failure count and could drop the chunk)."""
+    svc = MasterService(timeout_s=30.0)
+    svc.set_dataset(["a", "b"])
+    r1 = svc.rpc_cached("n1:1")
+    assert r1 is None
+    t1 = svc.get_task("t0")
+    svc.rpc_record("n1:1", {"ok": True, "result": t1})
+    # retry with the same token: cached reply, queue untouched
+    assert svc.rpc_cached("n1:1") == {"ok": True, "result": t1}
+    assert svc.progress()["pending"] == 1
+    # a NEW call (next seq) advances the queue normally
+    assert svc.rpc_cached("n1:2") is None
+    t2 = svc.get_task("t0")
+    assert t2["payload"] != t1["payload"]
+
+
+def test_master_client_end_to_end_retry_has_seq(tmp_path):
+    svc = MasterService(timeout_s=30.0)
+    svc.set_dataset(["x"])
+    srv = MasterServer(svc).start()
+    try:
+        c = MasterClient(srv.addr)
+        t = c.get_task()
+        assert t["payload"] == "x"
+        assert svc._rpc_cache  # the transport attached a seq token
+    finally:
+        srv.stop()
