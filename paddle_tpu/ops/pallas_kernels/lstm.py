@@ -10,8 +10,10 @@ Layout: time-major. The TPU Pallas grid is sequential, so grid=(T,) with
 VMEM scratch for (h, c) implements the scan; per step one [B,H]x[H,4H] MXU
 GEMM + VPU gate math. Gate order matches operators/lstm_op.cc: i, f, c̃, o.
 
-Used on the inference path (forward only); training keeps the differentiable
-`lax.scan` form so desc-level autodiff is untouched.
+Inference uses the forward kernel alone; training pairs it with the fused
+BPTT backward kernel below via jax.custom_vjp (make_lstm_train), which the
+desc-level autodiff honors because generic_grad differentiates emitters
+with jax.vjp.
 """
 
 from __future__ import annotations
@@ -131,3 +133,177 @@ def usable(x_proj, attrs) -> bool:
     # (kept resident — see the constant-index BlockSpec); stay under ~8MB
     step_bytes = 4 * (H * H4 + B * H4 + 3 * B * H + T * B)
     return step_bytes < 8 * 1024 * 1024
+
+
+def usable_train(x_proj, attrs) -> bool:
+    """Training additionally runs the BPTT kernel, whose residency is
+    dominated by THREE [H,4H] f32 weight-sized buffers (w block, dw
+    scratch, dw output) plus six [B,*] step blocks — budget it separately
+    or shapes that pass the forward check fail Mosaic mid-training."""
+    if not usable(x_proj, attrs):
+        return False
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    bwd_bytes = 4 * (3 * H * H4 + 2 * B * H4 + 7 * B * H + T * B)
+    return bwd_bytes < 8 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Training path: fused BPTT backward + custom_vjp wrapper
+#
+# The reference's training recurrence was also a hand-fused kernel pair
+# (hl_gpu_lstm.cuh forward/backward). Here the backward re-derives the gate
+# pre-activations from (x_t, h_{t-1}, W) — one extra MXU GEMM per step —
+# instead of storing them, keeping the saved-activation footprint at the
+# scan's level while the whole reverse loop stays VMEM-resident.
+
+
+def _bwd_kernel(x_ref, m_ref, hp_ref, cp_ref, dh_ref, dc_ref, w_ref,
+                dx_ref, dw_ref, dh0_ref, dc0_ref, dh_sc, dc_sc, dw_sc):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)       # 0..T-1, with index maps serving REVERSED time
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_sc[...] = jnp.zeros_like(dh_sc)
+        dc_sc[...] = jnp.zeros_like(dc_sc)
+        dw_sc[...] = jnp.zeros_like(dw_sc)
+
+    w = w_ref[...]
+    H = w.shape[0]
+    x_t = x_ref[0].astype(jnp.float32)
+    h_prev = hp_ref[0].astype(jnp.float32)
+    c_prev = cp_ref[0].astype(jnp.float32)
+    # incoming grads for this (reversed) step's outputs + carried state grads
+    dh_acc = dh_ref[0].astype(jnp.float32) + dh_sc[...]
+    dc_acc = dc_ref[0].astype(jnp.float32) + dc_sc[...]
+    # resident [T,B] mask is indexed in FORWARD time; this grid runs reversed
+    m = m_ref[pl.ds(T - 1 - t, 1), :].astype(jnp.float32).reshape(-1, 1)
+
+    # recompute the forward step's internals (rematerialization)
+    gates = x_t + jax.lax.dot_general(
+        h_prev.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, :H])
+    f = jax.nn.sigmoid(gates[:, H:2 * H])
+    u = jnp.tanh(gates[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(gates[:, 3 * H:])
+    c_raw = f * c_prev + i * u
+    tc = jnp.tanh(c_raw)
+
+    # masked-step calculus: h_t = m*h_raw + (1-m)*h_prev (same for c)
+    dh_raw = m * dh_acc
+    dc_raw = m * dc_acc + dh_raw * o * (1.0 - tc * tc)
+    do = dh_raw * tc
+    di = dc_raw * u
+    df = dc_raw * c_prev
+    du = dc_raw * i
+    dg = jnp.concatenate([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        du * (1.0 - u * u),
+        do * o * (1.0 - o),
+    ], axis=1)  # [B, 4H]
+
+    dx_ref[0] = dg.astype(dx_ref.dtype)
+    dw_sc[...] += jax.lax.dot_general(
+        h_prev, dg, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # carries for the next (earlier) step
+    dh_sc[...] = (1.0 - m) * dh_acc + jax.lax.dot_general(
+        dg.astype(w.dtype), w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_sc[...] = (1.0 - m) * dc_acc + dc_raw * f
+
+    @pl.when(t == T - 1)
+    def _final():
+        dw_ref[...] = dw_sc[...].astype(dw_ref.dtype)
+        dh0_ref[...] = dh_sc[...].astype(dh0_ref.dtype)
+        dc0_ref[...] = dc_sc[...].astype(dc0_ref.dtype)
+
+
+def lstm_backward(x_proj, h0, c0, w, lengths, hs, cs, dhs, dcs,
+                  interpret: bool = False):
+    """VJP of lstm_forward w.r.t. (x_proj, h0, c0, w): reverse-time fused
+    loop; (hs, cs) are the saved primal outputs (already materialized —
+    only the gate pre-activations are recomputed), (dhs, dcs) their
+    cotangents."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+    h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)
+    c_prev = jnp.concatenate([c0[:, None], cs[:, :-1]], axis=1)
+
+    tm = lambda a: jnp.moveaxis(a, 1, 0)  # [B,T,...] -> [T,B,...]
+    rev = lambda t: (T - 1 - t, 0, 0)     # reversed-time block stream
+
+    dx_t, dw, dh0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), rev),       # x_t
+            pl.BlockSpec((T, B), lambda t: (0, 0)),  # mask, resident;
+            pl.BlockSpec((1, B, H), rev),        # h_{t-1}  (ds uses fwd t)
+            pl.BlockSpec((1, B, H), rev),        # c_{t-1}
+            pl.BlockSpec((1, B, H), rev),        # dhs_t
+            pl.BlockSpec((1, B, H), rev),        # dcs_t
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),  # W resident
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H4), x_proj.dtype),
+            jax.ShapeDtypeStruct((H, H4), w.dtype),
+            jax.ShapeDtypeStruct((B, H), h0.dtype),
+            jax.ShapeDtypeStruct((B, H), c0.dtype),
+        ],
+        scratch_shapes=[
+            _vmem()((B, H), jnp.float32),
+            _vmem()((B, H), jnp.float32),
+            _vmem()((H, H4), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tm(x_proj), mask.T, tm(h_prev), tm(c_prev), tm(dhs), tm(dcs), w)
+    return jnp.moveaxis(dx_t, 0, 1), dh0, dc0, dw
+
+
+def make_lstm_train(interpret: bool = False):
+    """custom_vjp-wrapped fused LSTM for the TRAINING path: forward is the
+    Pallas time-loop, backward the fused BPTT kernel.  Composes with the
+    desc-level autodiff because generic_grad differentiates emitters with
+    jax.vjp, which honors custom_vjp."""
+    import jax
+
+    @jax.custom_vjp
+    def lstm_train(x_proj, h0, c0, w, lengths):
+        hs, cs, _, _ = lstm_forward(x_proj, h0, c0, w, lengths,
+                                    interpret=interpret)
+        return hs, cs
+
+    def fwd(x_proj, h0, c0, w, lengths):
+        hs, cs, _, _ = lstm_forward(x_proj, h0, c0, w, lengths,
+                                    interpret=interpret)
+        return (hs, cs), (x_proj, h0, c0, w, lengths, hs, cs)
+
+    def bwd(res, cts):
+        x_proj, h0, c0, w, lengths, hs, cs = res
+        dhs, dcs = cts
+        dx, dh0, dc0, dw = lstm_backward(x_proj, h0, c0, w, lengths,
+                                         hs, cs, dhs, dcs,
+                                         interpret=interpret)
+        return dx, dh0, dc0, dw, None
+
+    lstm_train.defvjp(fwd, bwd)
+    return lstm_train

@@ -241,15 +241,19 @@ def lstm(ctx, ins, attrs):
         h0 = ins["H0"][0]
     if ins.get("C0") and ins["C0"][0] is not None:
         c0 = ins["C0"][0]
-    if ctx.is_test and ctx.target_platform() == "tpu":
-        # inference: fused Pallas time-loop (VMEM-resident state and
-        # weight); training keeps the differentiable scan below.  Gated on
-        # the trace's target device, not the process-global backend — an
+    if ctx.target_platform() == "tpu":
+        # fused Pallas time-loop (VMEM-resident state and weight): forward
+        # kernel at inference, forward+fused-BPTT-backward (custom_vjp —
+        # honored by the generic_grad jax.vjp) in training.  Gated on the
+        # trace's target device, not the process-global backend — an
         # Executor(CPUPlace()) in a TPU process must not lower Pallas/TPU.
         from .pallas_kernels import lstm as plstm
 
-        if plstm.usable(x, attrs):
+        if ctx.is_test and plstm.usable(x, attrs):
             hs, cs, _, _ = plstm.lstm_forward(x, h0, c0, w, lengths)
+            return {"Hidden": [hs], "Cell": [cs]}
+        if not ctx.is_test and plstm.usable_train(x, attrs):
+            hs, cs = plstm.make_lstm_train()(x, h0, c0, w, lengths)
             return {"Hidden": [hs], "Cell": [cs]}
     hs, cs, _, _ = _lstm_scan(
         x, h0, c0, w, lengths,
