@@ -1,0 +1,25 @@
+"""Shared plumbing for the fused recurrence kernels (lstm.py, gru.py):
+VMEM handle, padded-step mask, and the common eligibility gates — one
+place to adjust the VMEM budget or lane constraints for both."""
+
+from __future__ import annotations
+
+VMEM_BUDGET = 8 * 1024 * 1024  # comfortable share of ~16MB/core
+
+
+def vmem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM
+
+
+def step_mask(lengths, T, dtype):
+    """[B] lengths -> [B,T] {0,1} mask in `dtype`."""
+    import jax.numpy as jnp
+
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def lanes_ok(B: int, H: int) -> bool:
+    """MXU/VPU-friendly shapes: full 128-lane H tiles, 8-sublane batches."""
+    return H % 128 == 0 and B % 8 == 0

@@ -19,10 +19,8 @@ with jax.vjp.
 from __future__ import annotations
 
 
-def _vmem():
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.VMEM
+from ._common import VMEM_BUDGET, lanes_ok, step_mask  # noqa: F401
+from ._common import vmem as _vmem
 
 
 def _kernel(x_ref, m_ref, h0_ref, c0_ref, w_ref, hs_ref, cs_ref, hT_ref,
@@ -127,12 +125,12 @@ def usable(x_proj, attrs) -> bool:
         return False
     if bool(attrs.get("is_reverse", False)):
         return False
-    if H % 128 != 0 or B % 8 != 0:
+    if not lanes_ok(B, H):
         return False
     # VMEM budget (f32): w + x_t + 2*state + hs_t + the WHOLE [T,B] mask
     # (kept resident — see the constant-index BlockSpec); stay under ~8MB
     step_bytes = 4 * (H * H4 + B * H4 + 3 * B * H + T * B)
-    return step_bytes < 8 * 1024 * 1024
+    return step_bytes < VMEM_BUDGET
 
 
 def usable_train(x_proj, attrs) -> bool:
@@ -145,7 +143,7 @@ def usable_train(x_proj, attrs) -> bool:
     B, T, H4 = x_proj.shape
     H = H4 // 4
     bwd_bytes = 4 * (3 * H * H4 + 2 * B * H4 + 7 * B * H + T * B)
-    return bwd_bytes < 8 * 1024 * 1024
+    return bwd_bytes < VMEM_BUDGET
 
 
 # ---------------------------------------------------------------------------

@@ -310,6 +310,18 @@ def gru(ctx, ins, attrs):
     B = x.shape[0]
     h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
         jnp.zeros((B, H), x.dtype)
+    if ctx.target_platform() == "tpu":
+        # fused Pallas time loop (forward kernel at inference, custom_vjp
+        # forward+BPTT pair in training) — see pallas_kernels/gru.py; same
+        # device gating as the LSTM path
+        from .pallas_kernels import gru as pgru
+
+        if ctx.is_test and pgru.usable(x, attrs):
+            hs, _ = pgru.gru_forward(x, h0, w, lengths)
+            return {"Hidden": [hs]}
+        if not ctx.is_test and pgru.usable_train(x, attrs):
+            hs = pgru.make_gru_train()(x, h0, w, lengths)
+            return {"Hidden": [hs]}
     hs, _ = _gru_scan(
         x, h0, w, lengths,
         acts[attrs.get("gate_activation", "sigmoid")],

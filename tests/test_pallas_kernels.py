@@ -266,3 +266,77 @@ def test_lstm_fused_training_through_desc_autodiff(monkeypatch):
     np.testing.assert_allclose(fused_losses, scan_losses, rtol=2e-3,
                                atol=2e-4)
     assert fused_losses[-1] < fused_losses[0]  # it actually trains
+
+
+def test_pallas_gru_forward_and_backward_match_scan():
+    """Fused GRU kernel pair vs a plain scan with identical semantics
+    (interpret mode), forward and all three gradients."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import gru as pgru
+
+    B, T, H = 8, 6, 128
+    rng = np.random.RandomState(7)
+    x = jnp.asarray((rng.randn(B, T, 3 * H) * 0.3).astype(np.float32))
+    h0 = jnp.asarray((rng.randn(B, H) * 0.2).astype(np.float32))
+    w = jnp.asarray((rng.randn(H, 3 * H) * 0.05).astype(np.float32))
+    lengths = jnp.asarray(np.array([6, 6, 5, 4, 6, 3, 6, 2], np.int32))
+    assert pgru.usable(x, {}) and pgru.usable_train(x, {})
+    fused = pgru.make_gru_train(interpret=True)
+
+    def ref(x, h0, w):
+        mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(
+            jnp.float32)
+        wg, wc = w[:, :2 * H], w[:, 2 * H:]
+
+        def step(h, tup):
+            xt, mt = tup
+            g = xt[:, :2 * H] + h @ wg
+            u = jax.nn.sigmoid(g[:, :H])
+            r = jax.nn.sigmoid(g[:, H:])
+            c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ wc)
+            hn = u * h + (1 - u) * c
+            m = mt[:, None]
+            hn = m * hn + (1 - m) * h
+            return hn, hn
+
+        _, hs = jax.lax.scan(step, h0, (jnp.moveaxis(x, 1, 0), mask.T))
+        return jnp.moveaxis(hs, 0, 1)
+
+    np.testing.assert_allclose(
+        np.asarray(fused(x, h0, w, lengths)), np.asarray(ref(x, h0, w)),
+        atol=1e-5)
+    wv = jnp.cos(jnp.arange(H))
+    g1 = jax.grad(lambda *a: (fused(*a, lengths) * wv).sum(),
+                  argnums=(0, 1, 2))(x, h0, w)
+    g2 = jax.grad(lambda *a: (ref(*a) * wv).sum(), argnums=(0, 1, 2))(
+        x, h0, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_gru_op_training_dispatch_uses_fused_kernel(monkeypatch):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops import sequence_ops
+    from paddle_tpu.ops.pallas_kernels import gru as pgru
+
+    calls = []
+    real = pgru.make_gru_train
+    monkeypatch.setattr(pgru, "make_gru_train",
+                        lambda interpret=False: calls.append(1)
+                        or real(interpret=True))
+    B, T, H = 8, 4, 128
+    rng = np.random.RandomState(2)
+    x = jnp.asarray((rng.randn(B, T, 3 * H) * 0.2).astype(np.float32))
+    w = jnp.asarray((rng.randn(H, 3 * H) * 0.05).astype(np.float32))
+    lengths = jnp.asarray(np.full(B, T, np.int32))
+    ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=False)
+    monkeypatch.setattr(ctx, "target_platform", lambda: "tpu")
+    out = sequence_ops.gru(ctx, {"Input": [x], "Weight": [w],
+                                 "Length": [lengths]}, {})
+    assert calls == [1]
+    assert out["Hidden"][0].shape == (B, T, H)
