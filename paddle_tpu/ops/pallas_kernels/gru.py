@@ -14,7 +14,8 @@ padded-step mask mixing h/h_prev.
 from __future__ import annotations
 
 
-from ._common import VMEM_BUDGET, lanes_ok, step_mask  # noqa: F401
+from ._common import TRAIN_VMEM_BUDGET, VMEM_BUDGET  # noqa: F401
+from ._common import lanes_ok, step_mask  # noqa: F401
 from ._common import vmem as _vmem
 
 
@@ -64,7 +65,7 @@ def gru_forward(x_proj, h0, w, lengths, interpret: bool = False):
 
     B, T, H3 = x_proj.shape
     H = H3 // 3
-    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+    mask = step_mask(lengths, T, x_proj.dtype)
     xt = jnp.moveaxis(x_proj, 1, 0)
 
     hs, hT = pl.pallas_call(
@@ -91,7 +92,7 @@ def gru_forward(x_proj, h0, w, lengths, interpret: bool = False):
 
 
 def _bwd_kernel(x_ref, m_ref, hp_ref, dh_ref, w_ref,
-                dx_ref, dw_ref, dh0_ref, dh_sc, dw_sc):
+                dx_ref, dw_ref, dh0_ref, dh_sc):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -102,7 +103,7 @@ def _bwd_kernel(x_ref, m_ref, hp_ref, dh_ref, w_ref,
     @pl.when(t == 0)
     def _init():
         dh_sc[...] = jnp.zeros_like(dh_sc)
-        dw_sc[...] = jnp.zeros_like(dw_sc)
+        dw_ref[...] = jnp.zeros_like(dw_ref)  # resident dW accumulator
 
     w = w_ref[...]
     H = w.shape[0]
@@ -140,17 +141,16 @@ def _bwd_kernel(x_ref, m_ref, hp_ref, dh_ref, w_ref,
                                    (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
     dx_ref[0] = jnp.concatenate([dg, da_c], axis=1).astype(dx_ref.dtype)
-    dw_sc[:, : 2 * H] += jax.lax.dot_general(
+    dw_ref[:, : 2 * H] += jax.lax.dot_general(
         h_prev, dg, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dw_sc[:, 2 * H:] += jax.lax.dot_general(
+        preferred_element_type=jnp.float32).astype(dw_ref.dtype)
+    dw_ref[:, 2 * H:] += jax.lax.dot_general(
         rh, da_c, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32).astype(dw_ref.dtype)
     dh_sc[...] = dh_prev
 
     @pl.when(t == T - 1)
     def _final():
-        dw_ref[...] = dw_sc[...].astype(dw_ref.dtype)
         dh0_ref[...] = dh_sc[...].astype(dh0_ref.dtype)
 
 
@@ -163,7 +163,7 @@ def gru_backward(x_proj, h0, w, lengths, hs, dhs, interpret: bool = False):
 
     B, T, H3 = x_proj.shape
     H = H3 // 3
-    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+    mask = step_mask(lengths, T, jnp.float32)
     h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)
     tm = lambda a: jnp.moveaxis(a, 1, 0)
     rev = lambda t: (T - 1 - t, 0, 0)
@@ -185,16 +185,15 @@ def gru_backward(x_proj, h0, w, lengths, hs, dhs, interpret: bool = False):
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, B, H3), x_proj.dtype),
-            jax.ShapeDtypeStruct((H, H3), w.dtype),
+            jax.ShapeDtypeStruct((H, H3), jnp.float32),  # dW accumulator
             jax.ShapeDtypeStruct((B, H), h0.dtype),
         ],
         scratch_shapes=[
             _vmem()((B, H), jnp.float32),
-            _vmem()((H, H3), jnp.float32),
         ],
         interpret=interpret,
     )(tm(x_proj), mask.T, tm(h_prev), tm(dhs), w)
-    return jnp.moveaxis(dx_t, 0, 1), dh0, dw
+    return jnp.moveaxis(dx_t, 0, 1), dh0, dw.astype(w.dtype)
 
 
 def make_gru_train(interpret: bool = False):
@@ -242,5 +241,5 @@ def usable_train(x_proj, attrs) -> bool:
         return False
     B, T, H3 = x_proj.shape
     H = H3 // 3
-    bwd_bytes = 4 * (3 * H * H3 + 2 * B * H3 + 6 * B * H + T * B)
-    return bwd_bytes < VMEM_BUDGET
+    bwd_bytes = 4 * (2 * H * H3 + 2 * B * H3 + 6 * B * H + T * B)
+    return bwd_bytes < TRAIN_VMEM_BUDGET

@@ -19,7 +19,8 @@ with jax.vjp.
 from __future__ import annotations
 
 
-from ._common import VMEM_BUDGET, lanes_ok, step_mask  # noqa: F401
+from ._common import TRAIN_VMEM_BUDGET, VMEM_BUDGET  # noqa: F401
+from ._common import lanes_ok, step_mask  # noqa: F401
 from ._common import vmem as _vmem
 
 
@@ -77,7 +78,7 @@ def lstm_forward(x_proj, h0, c0, w, lengths, interpret: bool = False):
 
     B, T, H4 = x_proj.shape
     H = H4 // 4
-    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+    mask = step_mask(lengths, T, x_proj.dtype)
     xt = jnp.moveaxis(x_proj, 1, 0)   # [T, B, 4H] time-major
     mt = mask.T                        # [T, B]
 
@@ -135,15 +136,16 @@ def usable(x_proj, attrs) -> bool:
 
 def usable_train(x_proj, attrs) -> bool:
     """Training additionally runs the BPTT kernel, whose residency is
-    dominated by THREE [H,4H] f32 weight-sized buffers (w block, dw
-    scratch, dw output) plus six [B,*] step blocks — budget it separately
-    or shapes that pass the forward check fail Mosaic mid-training."""
+    dominated by TWO [H,4H] f32 weight-sized buffers (w block + the
+    resident dW output accumulator) plus six [B,*] step blocks — budget it
+    separately or shapes that pass the forward check fail Mosaic
+    mid-training."""
     if not usable(x_proj, attrs):
         return False
     B, T, H4 = x_proj.shape
     H = H4 // 4
-    bwd_bytes = 4 * (3 * H * H4 + 2 * B * H4 + 7 * B * H + T * B)
-    return bwd_bytes < VMEM_BUDGET
+    bwd_bytes = 4 * (2 * H * H4 + 2 * B * H4 + 7 * B * H + T * B)
+    return bwd_bytes < TRAIN_VMEM_BUDGET
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +159,7 @@ def usable_train(x_proj, attrs) -> bool:
 
 
 def _bwd_kernel(x_ref, m_ref, hp_ref, cp_ref, dh_ref, dc_ref, w_ref,
-                dx_ref, dw_ref, dh0_ref, dc0_ref, dh_sc, dc_sc, dw_sc):
+                dx_ref, dw_ref, dh0_ref, dc0_ref, dh_sc, dc_sc):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -169,7 +171,9 @@ def _bwd_kernel(x_ref, m_ref, hp_ref, cp_ref, dh_ref, dc_ref, w_ref,
     def _init():
         dh_sc[...] = jnp.zeros_like(dh_sc)
         dc_sc[...] = jnp.zeros_like(dc_sc)
-        dw_sc[...] = jnp.zeros_like(dw_sc)
+        # dW accumulates IN the resident output block (constant index map)
+        # — one weight-size buffer instead of scratch + output
+        dw_ref[...] = jnp.zeros_like(dw_ref)
 
     w = w_ref[...]
     H = w.shape[0]
@@ -208,9 +212,9 @@ def _bwd_kernel(x_ref, m_ref, hp_ref, cp_ref, dh_ref, dc_ref, w_ref,
     ], axis=1)  # [B, 4H]
 
     dx_ref[0] = dg.astype(dx_ref.dtype)
-    dw_sc[...] += jax.lax.dot_general(
+    dw_ref[...] += jax.lax.dot_general(
         h_prev, dg, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32).astype(dw_ref.dtype)
     # carries for the next (earlier) step
     dh_sc[...] = (1.0 - m) * dh_acc + jax.lax.dot_general(
         dg.astype(w.dtype), w, (((1,), (1,)), ((), ())),
@@ -219,7 +223,6 @@ def _bwd_kernel(x_ref, m_ref, hp_ref, cp_ref, dh_ref, dc_ref, w_ref,
 
     @pl.when(t == T - 1)
     def _final():
-        dw_ref[...] = dw_sc[...].astype(dw_ref.dtype)
         dh0_ref[...] = dh_sc[...].astype(dh0_ref.dtype)
         dc0_ref[...] = dc_sc[...].astype(dc0_ref.dtype)
 
@@ -236,7 +239,7 @@ def lstm_backward(x_proj, h0, c0, w, lengths, hs, cs, dhs, dcs,
 
     B, T, H4 = x_proj.shape
     H = H4 // 4
-    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+    mask = step_mask(lengths, T, jnp.float32)
     h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)
     c_prev = jnp.concatenate([c0[:, None], cs[:, :-1]], axis=1)
 
@@ -263,18 +266,17 @@ def lstm_backward(x_proj, h0, c0, w, lengths, hs, cs, dhs, dcs,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, B, H4), x_proj.dtype),
-            jax.ShapeDtypeStruct((H, H4), w.dtype),
+            jax.ShapeDtypeStruct((H, H4), jnp.float32),  # dW accumulator
             jax.ShapeDtypeStruct((B, H), h0.dtype),
             jax.ShapeDtypeStruct((B, H), c0.dtype),
         ],
         scratch_shapes=[
             _vmem()((B, H), jnp.float32),
             _vmem()((B, H), jnp.float32),
-            _vmem()((H, H4), jnp.float32),
         ],
         interpret=interpret,
     )(tm(x_proj), mask.T, tm(h_prev), tm(c_prev), tm(dhs), tm(dcs), w)
-    return jnp.moveaxis(dx_t, 0, 1), dh0, dc0, dw
+    return jnp.moveaxis(dx_t, 0, 1), dh0, dc0, dw.astype(w.dtype)
 
 
 def make_lstm_train(interpret: bool = False):
