@@ -120,20 +120,23 @@ def scaled_dot_product_attention(ctx, ins, attrs):
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
     else:
         out = None
-        if ctx.is_test and ctx.target_platform() == "tpu" and \
-                getattr(ctx, "mesh", None) is None:
-            # inference fast path: the Pallas flash kernel (VMEM-tiled
-            # online softmax).  Training keeps the XLA-fused dense path
-            # (pallas_call has no vjp rule here), and so does any sharded
-            # mesh execution (GSPMD cannot partition the Mosaic call).
-            # Shape gates per the kernel's contract: self-attention
-            # lengths, T tiles of 128, lane-width head dim.
+        if ctx.target_platform() == "tpu" and mesh is None:
+            # single-chip fast path: the Pallas flash kernel (VMEM-tiled
+            # online softmax); training goes through the custom_vjp pair
+            # (FlashAttention-2-style blockwise backward), which
+            # generic_grad's jax.vjp honors.  Sharded mesh execution keeps
+            # the XLA-fused dense path (GSPMD cannot partition the Mosaic
+            # call).  Shape gates per the kernel's contract:
+            # self-attention lengths, T tiles of 128, lane-width head dim.
             T, D = q.shape[2], q.shape[3]
             if (T % 128 == 0 and D <= 128 and k.shape[2] == T
                     and v.shape[2] == T):
-                from .pallas_kernels.flash_attention import flash_attention
+                from .pallas_kernels import flash_attention as fa
 
-                out = flash_attention(q, k, v, causal=causal)
+                if ctx.is_test:
+                    out = fa.flash_attention(q, k, v, causal=causal)
+                else:
+                    out = fa.make_flash_train(causal=causal)(q, k, v)
         if out is None:
             out = ra.attention(q, k, v, causal=causal)
     return {"Out": [out]}

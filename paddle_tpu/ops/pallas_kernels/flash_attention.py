@@ -98,3 +98,263 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(B, H, T, D)
+
+
+# ---------------------------------------------------------------------------
+# Training: forward-with-logsumexp + blockwise backward (FlashAttention-2
+# style recompute — P is never materialized in HBM in either direction).
+
+
+def _kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int,
+                scale: float, causal: bool, bq: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    T = k_ref.shape[1]
+    D = q.shape[-1]
+    nblk = T // bk
+    m0 = jnp.full((q.shape[0],), -1e30, dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
+    o0 = jnp.zeros((q.shape[0], D), dtype=jnp.float32)
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o * corr[:, None] + pv
+
+    nblk_eff = ((qi + 1) * bq + bk - 1) // bk if causal else nblk
+    m, l, o = jax.lax.fori_loop(0, nblk_eff, body, (m0, l0, o0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               bk: int, scale: float, causal: bool, bq: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]  # consumed at v.dtype by the dp GEMM — no f32 staging
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    T = k_ref.shape[1]
+    D = q.shape[-1]
+    nblk = T // bk
+    dq0 = jnp.zeros((q.shape[0], D), dtype=jnp.float32)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * bk, bk), :]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse[:, None])  # true softmax probs via saved lse
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    nblk_eff = ((qi + 1) * bq + bk - 1) // bk if causal else nblk
+    dq = jax.lax.fori_loop(0, nblk_eff, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, bq: int, scale: float, causal: bool,
+                bk: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    T = q_ref.shape[1]
+    D = k.shape[-1]
+    nblk = T // bq
+    dk0 = jnp.zeros((k.shape[0], D), dtype=jnp.float32)
+    dv0 = jnp.zeros((k.shape[0], D), dtype=jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :]
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, k.shape[0]), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, k.shape[0]), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        first = (ki * bk) // bq  # earliest q block attending this k block
+    else:
+        first = 0
+    dk, dv = jax.lax.fori_loop(first, nblk, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
+                        block_k=128, interpret=False):
+    """Forward that also returns the per-row logsumexp (backward residual)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    bq, bk = min(block_q, T), min(block_k, T)
+    assert T % bq == 0 and T % bk == 0, (T, bq, bk)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf, kf, vf = (a.reshape(B * H, T, D) for a in (q, k, v))
+    out, lse = pl.pallas_call(
+        functools.partial(_kernel_lse, bk=bk, scale=s, causal=causal,
+                          bq=bq),
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D), lse
+
+
+def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
+                        block_q=128, block_k=128, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    bq, bk = min(block_q, T), min(block_k, T)
+    assert T % bq == 0 and T % bk == 0, (T, bq, bk)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf, kf, vf, of, dof = (a.reshape(B * H, T, D)
+                           for a in (q, k, v, o, do))
+    delta = jnp.sum(of.astype(jnp.float32) * dof.astype(jnp.float32),
+                    axis=-1)  # [BH, T]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bk=bk, scale=s, causal=causal,
+                          bq=bq),
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, scale=s, causal=causal,
+                          bk=bk),
+        grid=(B * H, T // bk),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    rs = lambda a: a.reshape(B, H, T, D)
+    return rs(dq), rs(dk), rs(dv)
+
+
+def make_flash_train(causal: bool = False, scale=None, interpret=False):
+    """custom_vjp fused attention for TRAINING (honored by generic_grad's
+    jax.vjp like the recurrence kernels)."""
+    import jax
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                     interpret=interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                       interpret=interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return flash_attention_bwd(q, k, v, out, lse, do, causal=causal,
+                                   scale=scale, interpret=interpret)
+
+    attn.defvjp(fwd, bwd)
+    return attn

@@ -112,12 +112,18 @@ def test_sdp_op_dispatches_flash_on_tpu_inference(monkeypatch):
                                np.asarray(attention(q, q, q, causal=True)),
                                rtol=2e-5, atol=2e-5)
 
-    # training mode keeps dense (no new call)
+    # training mode takes the custom_vjp flash pair, not the plain kernel
+    train_calls = []
+    real_train = fa_mod.make_flash_train
+    monkeypatch.setattr(
+        fa_mod, "make_flash_train",
+        lambda causal=False, scale=None, interpret=False:
+        train_calls.append(1) or real_train(causal=causal, interpret=True))
     ctx2 = reg.EmitContext(jax.random.PRNGKey(0), is_test=False)
     monkeypatch.setattr(ctx2, "target_platform", lambda: "tpu")
     attention_ops.scaled_dot_product_attention(
         ctx2, {"Q": [q], "K": [q], "V": [q]}, {"causal": True})
-    assert len(calls) == 1
+    assert len(calls) == 1 and train_calls == [1]
     # odd T keeps dense
     q2 = jnp.asarray(rng.rand(1, 2, 96, 16).astype(np.float32))
     attention_ops.scaled_dot_product_attention(
@@ -340,3 +346,56 @@ def test_gru_op_training_dispatch_uses_fused_kernel(monkeypatch):
                                  "Length": [lengths]}, {})
     assert calls == [1]
     assert out["Hidden"][0].shape == (B, T, H)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    """FlashAttention-2-style blockwise backward (dq/dk/dv) vs dense
+    attention gradients (interpret mode)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+
+    B, H, T, D = 1, 2, 256, 64
+    rng = np.random.RandomState(4)
+    q, k, v = (jnp.asarray((rng.randn(B, H, T, D) * 0.3).astype(np.float32))
+               for _ in range(3))
+
+    def dense(q, k, v):
+        s = (q @ jnp.swapaxes(k, -1, -2)) / (D ** 0.5)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jax.nn.softmax(s, axis=-1) @ v
+
+    f = fa.make_flash_train(causal=causal, interpret=True)
+    wv = jnp.cos(jnp.arange(D))
+    g1 = jax.grad(lambda *a: (f(*a) * wv).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (dense(*a) * wv).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_sdp_op_training_dispatch_uses_flash_vjp(monkeypatch):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops import attention_ops
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+
+    calls = []
+    real = fa.make_flash_train
+    monkeypatch.setattr(
+        fa, "make_flash_train",
+        lambda causal=False, scale=None, interpret=False:
+        calls.append(1) or real(causal=causal, interpret=True))
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.rand(1, 2, 128, 32).astype(np.float32))
+    ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=False)
+    monkeypatch.setattr(ctx, "target_platform", lambda: "tpu")
+    out = attention_ops.scaled_dot_product_attention(
+        ctx, {"Q": [q], "K": [q], "V": [q]}, {"causal": True})
+    assert calls == [1]
+    assert out["Out"][0].shape == q.shape
