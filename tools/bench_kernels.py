@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""On-chip microbenchmarks: fused Pallas kernels vs their XLA fallbacks.
+
+Run on a real TPU (no args):
+    python tools/bench_kernels.py
+
+Covers the three custom-fusion-tier kernels (SURVEY.md §2.10): LSTM
+train step (fused fwd+BPTT vs lax.scan), GRU train step, and flash
+attention train step (custom_vjp pair vs XLA-fused dense)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _timeit(f, *args, iters=20):
+    import jax
+
+    f(*args)  # compile
+    for _ in range(3):
+        out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def bench_lstm():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import lstm as plstm
+    from paddle_tpu.ops.sequence_ops import _lstm_scan
+
+    B, T, H = 64, 96, 512
+    rng = np.random.RandomState(0)
+    x = jnp.asarray((rng.randn(B, T, 4 * H) * 0.1).astype(np.float32))
+    h0 = jnp.zeros((B, H), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    w = jnp.asarray((rng.randn(H, 4 * H) * 0.05).astype(np.float32))
+    lengths = jnp.full((B,), T, jnp.int32)
+    fused = plstm.make_lstm_train()
+    sig = jax.nn.sigmoid
+
+    @jax.jit
+    def fused_step(x, h0, c0, w):
+        def loss(x, w):
+            hs, cs = fused(x, h0, c0, w, lengths)
+            return hs.sum() + cs.sum()
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    @jax.jit
+    def scan_step(x, h0, c0, w):
+        def loss(x, w):
+            hs, cs, _, _ = _lstm_scan(x, h0, c0, w, lengths, sig, jnp.tanh,
+                                      jnp.tanh)
+            return hs.sum() + cs.sum()
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    print(f"lstm  train bs{B} T{T} h{H}: "
+          f"fused {_timeit(fused_step, x, h0, c0, w):.2f} ms vs "
+          f"scan {_timeit(scan_step, x, h0, c0, w):.2f} ms")
+
+
+def bench_gru():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import gru as pgru
+    from paddle_tpu.ops.sequence_ops import _gru_scan
+
+    B, T, H = 64, 96, 512
+    rng = np.random.RandomState(1)
+    x = jnp.asarray((rng.randn(B, T, 3 * H) * 0.1).astype(np.float32))
+    h0 = jnp.zeros((B, H), jnp.float32)
+    w = jnp.asarray((rng.randn(H, 3 * H) * 0.05).astype(np.float32))
+    lengths = jnp.full((B,), T, jnp.int32)
+    fused = pgru.make_gru_train()
+
+    @jax.jit
+    def fused_step(x, h0, w):
+        return jax.grad(
+            lambda x, w: fused(x, h0, w, lengths).sum(),
+            argnums=(0, 1))(x, w)
+
+    @jax.jit
+    def scan_step(x, h0, w):
+        def loss(x, w):
+            hs, _ = _gru_scan(x, h0, w, lengths, jax.nn.sigmoid, jnp.tanh)
+            return hs.sum()
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    print(f"gru   train bs{B} T{T} h{H}: "
+          f"fused {_timeit(fused_step, x, h0, w):.2f} ms vs "
+          f"scan {_timeit(scan_step, x, h0, w):.2f} ms")
+
+
+def bench_flash():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+    from paddle_tpu.parallel.ring_attention import attention as dense
+
+    B, H, T, D = 8, 16, 2048, 64
+    rng = np.random.RandomState(2)
+    mk = lambda: jnp.asarray(
+        (rng.randn(B, H, T, D) * 0.2).astype(np.float32), dtype=jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    fused = fa.make_flash_train(causal=True)
+
+    @jax.jit
+    def fused_step(q, k, v):
+        return jax.grad(lambda *a: fused(*a).astype(jnp.float32).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+
+    @jax.jit
+    def dense_step(q, k, v):
+        return jax.grad(
+            lambda *a: dense(*a, causal=True).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    print(f"flash train b{B} h{H} T{T} d{D} bf16: "
+          f"fused {_timeit(fused_step, q, k, v):.2f} ms vs "
+          f"dense {_timeit(dense_step, q, k, v):.2f} ms")
+
+
+if __name__ == "__main__":
+    bench_lstm()
+    bench_gru()
+    bench_flash()
