@@ -128,8 +128,11 @@ def scaled_dot_product_attention(ctx, ins, attrs):
             # the XLA-fused dense path (GSPMD cannot partition the Mosaic
             # call).  Shape gates per the kernel's contract:
             # self-attention lengths, T tiles of 128, lane-width head dim.
+            from .pallas_kernels._common import kernels_enabled
+
             T, D = q.shape[2], q.shape[3]
-            if (T % 128 == 0 and D <= 128 and k.shape[2] == T
+            if kernels_enabled() and (
+                    T % 128 == 0 and D <= 128 and k.shape[2] == T
                     and v.shape[2] == T):
                 from .pallas_kernels import flash_attention as fa
 

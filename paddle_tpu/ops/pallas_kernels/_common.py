@@ -27,3 +27,12 @@ def step_mask(lengths, T, dtype):
 def lanes_ok(B: int, H: int) -> bool:
     """MXU/VPU-friendly shapes: full 128-lane H tiles, 8-sublane batches."""
     return H % 128 == 0 and B % 8 == 0
+
+
+def kernels_enabled() -> bool:
+    """PADDLE_TPU_NO_FUSED_KERNELS=1 forces every op back to its XLA
+    fallback — the escape hatch if a fused path regresses on some
+    chip/toolchain before the dispatch gates learn about it."""
+    import os
+
+    return not os.environ.get("PADDLE_TPU_NO_FUSED_KERNELS")

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 
 from ._common import TRAIN_VMEM_BUDGET, VMEM_BUDGET  # noqa: F401
-from ._common import lanes_ok, step_mask  # noqa: F401
+from ._common import kernels_enabled, lanes_ok, step_mask  # noqa: F401
 from ._common import vmem as _vmem
 
 
@@ -224,6 +224,8 @@ def usable(x_proj, attrs) -> bool:
     lane-friendly H, VMEM-resident weight + step blocks."""
     B, T, H3 = x_proj.shape
     H = H3 // 3
+    if not kernels_enabled():
+        return False
     if attrs.get("gate_activation", "sigmoid") != "sigmoid":
         return False
     if attrs.get("activation", "tanh") != "tanh":

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 
 from ._common import TRAIN_VMEM_BUDGET, VMEM_BUDGET  # noqa: F401
-from ._common import lanes_ok, step_mask  # noqa: F401
+from ._common import kernels_enabled, lanes_ok, step_mask  # noqa: F401
 from ._common import vmem as _vmem
 
 
@@ -118,6 +118,8 @@ def usable(x_proj, attrs) -> bool:
     whole weight + one step fitting VMEM comfortably."""
     B, T, H4 = x_proj.shape
     H = H4 // 4
+    if not kernels_enabled():
+        return False
     if attrs.get("gate_activation", "sigmoid") != "sigmoid":
         return False
     if attrs.get("cell_activation", "tanh") != "tanh":
