@@ -399,3 +399,38 @@ def test_sdp_op_training_dispatch_uses_flash_vjp(monkeypatch):
         ctx, {"Q": [q], "K": [q], "V": [q]}, {"causal": True})
     assert calls == [1]
     assert out["Out"][0].shape == q.shape
+
+
+def test_fused_rnn_kernels_bf16():
+    """bf16 in/out (the bench dtype) flows through both fused training
+    kernels with f32 accumulation and finite grads."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import gru as pgru
+    from paddle_tpu.ops.pallas_kernels import lstm as plstm
+
+    rng = np.random.RandomState(0)
+    B, T, H = 8, 4, 128
+    h0 = jnp.zeros((B, H), jnp.bfloat16)
+    c0 = jnp.zeros((B, H), jnp.bfloat16)
+    L = jnp.full((B,), T, jnp.int32)
+    x = jnp.asarray((rng.randn(B, T, 4 * H) * 0.2).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    w = jnp.asarray((rng.randn(H, 4 * H) * 0.05).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    f = plstm.make_lstm_train(interpret=True)
+    g = jax.grad(lambda x, w: f(x, h0, c0, w, L)[0].astype(
+        jnp.float32).sum(), argnums=(0, 1))(x, w)
+    assert g[0].dtype == jnp.bfloat16 and g[1].dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(g[0].astype(jnp.float32)).all())
+
+    xg = jnp.asarray((rng.randn(B, T, 3 * H) * 0.2).astype(np.float32),
+                     dtype=jnp.bfloat16)
+    wg = jnp.asarray((rng.randn(H, 3 * H) * 0.05).astype(np.float32),
+                     dtype=jnp.bfloat16)
+    fg = pgru.make_gru_train(interpret=True)
+    gg = jax.grad(lambda x, w: fg(x, h0, w, L).astype(jnp.float32).sum(),
+                  argnums=(0, 1))(xg, wg)
+    assert gg[0].dtype == jnp.bfloat16 and gg[1].dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(gg[0].astype(jnp.float32)).all())
