@@ -36,3 +36,26 @@ def kernels_enabled() -> bool:
     import os
 
     return not os.environ.get("PADDLE_TPU_NO_FUSED_KERNELS")
+
+
+def reverse_within_length(x, lengths, pad_fill=None):
+    """Flip each row's first `lengths[b]` steps, keeping padding at the
+    tail ([B,T,...]): a reversed recurrence over padded+lengths data is
+    the forward kernel run on this view (with outputs flipped back).
+    `pad_fill` (a [B,...] state, broadcast over time) overwrites the tail
+    — the reversed-scan convention for OUTPUT arrays, whose pad steps
+    carry the untouched initial state (h0/c0)."""
+    import jax.numpy as jnp
+
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = lengths[:, None] - 1 - idx
+    rev = jnp.where(rev >= 0, rev, idx)
+    out = jnp.take_along_axis(
+        x, rev.astype(jnp.int32).reshape(rev.shape + (1,) * (x.ndim - 2)),
+        axis=1)
+    if pad_fill is not None:
+        m = step_mask(lengths, T, jnp.bool_)
+        m = m.reshape(m.shape + (1,) * (out.ndim - 2))
+        out = jnp.where(m, out, pad_fill[:, None].astype(out.dtype))
+    return out

@@ -230,8 +230,6 @@ def usable(x_proj, attrs) -> bool:
         return False
     if attrs.get("activation", "tanh") != "tanh":
         return False
-    if bool(attrs.get("is_reverse", False)):
-        return False
     if not lanes_ok(B, H):
         return False
     step_bytes = 4 * (H * H3 + B * H3 + 2 * B * H + T * B)

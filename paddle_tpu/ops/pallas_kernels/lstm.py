@@ -126,8 +126,6 @@ def usable(x_proj, attrs) -> bool:
         return False
     if attrs.get("candidate_activation", "tanh") != "tanh":
         return False
-    if bool(attrs.get("is_reverse", False)):
-        return False
     if not lanes_ok(B, H):
         return False
     # VMEM budget (f32): w + x_t + 2*state + hs_t + the WHOLE [T,B] mask

@@ -93,15 +93,11 @@ def sequence_reverse(ctx, ins, attrs):
     """Reverse each sequence within its true length (for bi-RNNs)."""
     import jax.numpy as jnp
 
+    from .pallas_kernels._common import reverse_within_length
+
     x = ins["X"][0]
     lengths = ins["Length"][0]
-    T = x.shape[1]
-    idx = jnp.arange(T)[None, :]
-    rev = lengths[:, None] - 1 - idx
-    rev = jnp.where(rev >= 0, rev, idx)  # padding keeps identity order
-    return {"Y": [jnp.take_along_axis(
-        x, rev.astype(jnp.int32).reshape(rev.shape + (1,) * (x.ndim - 2)),
-        axis=1)]}
+    return {"Y": [reverse_within_length(x, lengths)]}
 
 
 @register_op("sequence_conv", non_diff_inputs=("Length",))
@@ -247,13 +243,24 @@ def lstm(ctx, ins, attrs):
         # honored by the generic_grad jax.vjp) in training.  Gated on the
         # trace's target device, not the process-global backend — an
         # Executor(CPUPlace()) in a TPU process must not lower Pallas/TPU.
+        # is_reverse rides the same kernels through reverse-within-length
+        # views of input/outputs (bidirectional nets use both directions).
         from .pallas_kernels import lstm as plstm
+        from .pallas_kernels._common import reverse_within_length as _rev
 
-        if ctx.is_test and plstm.usable(x, attrs):
-            hs, cs, _, _ = plstm.lstm_forward(x, h0, c0, w, lengths)
-            return {"Hidden": [hs], "Cell": [cs]}
-        if not ctx.is_test and plstm.usable_train(x, attrs):
-            hs, cs = plstm.make_lstm_train()(x, h0, c0, w, lengths)
+        ok = (plstm.usable(x, attrs) if ctx.is_test
+              else plstm.usable_train(x, attrs))
+        if ok:
+            rev = bool(attrs.get("is_reverse", False))
+            xk = _rev(x, lengths) if rev else x
+            if ctx.is_test:
+                hs, cs, _, _ = plstm.lstm_forward(xk, h0, c0, w, lengths)
+            else:
+                hs, cs = plstm.make_lstm_train()(xk, h0, c0, w, lengths)
+            if rev:
+                # scan convention: reversed pads carry the initial state
+                hs = _rev(hs, lengths, pad_fill=h0)
+                cs = _rev(cs, lengths, pad_fill=c0)
             return {"Hidden": [hs], "Cell": [cs]}
     hs, cs, _, _ = _lstm_scan(
         x, h0, c0, w, lengths,
@@ -313,14 +320,21 @@ def gru(ctx, ins, attrs):
     if ctx.target_platform() == "tpu":
         # fused Pallas time loop (forward kernel at inference, custom_vjp
         # forward+BPTT pair in training) — see pallas_kernels/gru.py; same
-        # device gating as the LSTM path
+        # device gating + reverse-within-length handling as the LSTM path
         from .pallas_kernels import gru as pgru
+        from .pallas_kernels._common import reverse_within_length as _rev
 
-        if ctx.is_test and pgru.usable(x, attrs):
-            hs, _ = pgru.gru_forward(x, h0, w, lengths)
-            return {"Hidden": [hs]}
-        if not ctx.is_test and pgru.usable_train(x, attrs):
-            hs = pgru.make_gru_train()(x, h0, w, lengths)
+        ok = (pgru.usable(x, attrs) if ctx.is_test
+              else pgru.usable_train(x, attrs))
+        if ok:
+            rev = bool(attrs.get("is_reverse", False))
+            xk = _rev(x, lengths) if rev else x
+            if ctx.is_test:
+                hs, _ = pgru.gru_forward(xk, h0, w, lengths)
+            else:
+                hs = pgru.make_gru_train()(xk, h0, w, lengths)
+            if rev:
+                hs = _rev(hs, lengths, pad_fill=h0)
             return {"Hidden": [hs]}
     hs, _ = _gru_scan(
         x, h0, w, lengths,

@@ -73,7 +73,8 @@ def test_pallas_lstm_usable_gate():
 
     x = np.zeros((8, 4, 512), np.float32)
     assert usable(x, {})
-    assert not usable(x, {"is_reverse": True})
+    # is_reverse is handled by reverse-within-length views, not gated out
+    assert usable(x, {"is_reverse": True})
     assert not usable(x, {"gate_activation": "tanh"})
     assert not usable(np.zeros((7, 4, 512), np.float32), {})  # B % 8
     assert not usable(np.zeros((8, 4, 4 * 100), np.float32), {})  # H % 128
@@ -434,3 +435,106 @@ def test_fused_rnn_kernels_bf16():
                   argnums=(0, 1))(xg, wg)
     assert gg[0].dtype == jnp.bfloat16 and gg[1].dtype == jnp.bfloat16
     assert bool(jnp.isfinite(gg[0].astype(jnp.float32)).all())
+
+
+def test_fused_rnn_reverse_direction_matches_scan(monkeypatch):
+    """is_reverse rides the fused kernels via reverse-within-length views;
+    outputs must match the reversed scan (the bidirectional-net layer)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops import sequence_ops
+    from paddle_tpu.ops.pallas_kernels import lstm as plstm
+
+    real = plstm.lstm_forward
+    monkeypatch.setattr(
+        plstm, "lstm_forward",
+        lambda *a, **kw: real(*a, **{**kw, "interpret": True}))
+    B, T, H = 8, 6, 128
+    rng = np.random.RandomState(9)
+    x = jnp.asarray((rng.randn(B, T, 4 * H) * 0.2).astype(np.float32))
+    w = jnp.asarray((rng.randn(H, 4 * H) * 0.05).astype(np.float32))
+    lengths = jnp.asarray(np.array([6, 5, 4, 3, 6, 2, 6, 1], np.int32))
+    ins = {"Input": [x], "Weight": [w], "Length": [lengths]}
+
+    # nonzero initial state: pad positions must carry h0/c0 exactly like
+    # the reversed scan does (bit-level convention, not just masked match)
+    h0 = jnp.asarray((rng.randn(B, H) * 0.1).astype(np.float32))
+    c0 = jnp.asarray((rng.randn(B, H) * 0.1).astype(np.float32))
+    ins = {**ins, "H0": [h0], "C0": [c0]}
+    ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=True)
+    monkeypatch.setattr(ctx, "target_platform", lambda: "tpu")
+    out_fused = sequence_ops.lstm(ctx, ins, {"is_reverse": True})
+    ctx2 = reg.EmitContext(jax.random.PRNGKey(0), is_test=True)  # cpu path
+    out_scan = sequence_ops.lstm(ctx2, ins, {"is_reverse": True})
+    np.testing.assert_allclose(np.asarray(out_fused["Hidden"][0]),
+                               np.asarray(out_scan["Hidden"][0]), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_fused["Cell"][0]),
+                               np.asarray(out_scan["Cell"][0]), atol=2e-5)
+
+
+def test_fused_rnn_reverse_training_and_gru(monkeypatch):
+    """Reverse direction through the TRAINING custom_vjp paths (gradients
+    vs the reversed scan) and the GRU reverse branch."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops import sequence_ops
+    from paddle_tpu.ops.pallas_kernels import gru as pgru
+    from paddle_tpu.ops.pallas_kernels import lstm as plstm
+
+    B, T, H = 8, 5, 128
+    rng = np.random.RandomState(11)
+    xl = jnp.asarray((rng.randn(B, T, 4 * H) * 0.2).astype(np.float32))
+    wl = jnp.asarray((rng.randn(H, 4 * H) * 0.05).astype(np.float32))
+    lengths = jnp.asarray(np.array([5, 4, 3, 2, 5, 1, 5, 5], np.int32))
+
+    import importlib
+    lstm_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.lstm")
+    real_train = lstm_mod.make_lstm_train
+    monkeypatch.setattr(lstm_mod, "make_lstm_train",
+                        lambda interpret=False: real_train(interpret=True))
+
+    def loss_emitter(x, w, is_test):
+        ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=is_test)
+        monkeypatch.setattr(ctx, "target_platform",
+                            lambda: "tpu" if not is_test else "cpu")
+        out = sequence_ops.lstm(
+            ctx, {"Input": [x], "Weight": [wl], "Length": [lengths]},
+            {"is_reverse": True})
+        return out["Hidden"][0].sum()
+
+    g_fused = jax.grad(lambda x: loss_emitter(x, wl, False))(xl)
+    # scan reference gradient (cpu target)
+    def loss_scan(x):
+        ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=False)
+        out = sequence_ops.lstm(
+            ctx, {"Input": [x], "Weight": [wl], "Length": [lengths]},
+            {"is_reverse": True})
+        return out["Hidden"][0].sum()
+    g_scan = jax.grad(loss_scan)(xl)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_scan),
+                               atol=3e-4)
+
+    # GRU reverse inference branch vs scan
+    gru_mod = importlib.import_module("paddle_tpu.ops.pallas_kernels.gru")
+    real_g = gru_mod.gru_forward
+    monkeypatch.setattr(
+        gru_mod, "gru_forward",
+        lambda *a, **kw: real_g(*a, **{**kw, "interpret": True}))
+    xg = jnp.asarray((rng.randn(B, T, 3 * H) * 0.2).astype(np.float32))
+    wg = jnp.asarray((rng.randn(H, 3 * H) * 0.05).astype(np.float32))
+    ctx = reg.EmitContext(jax.random.PRNGKey(0), is_test=True)
+    monkeypatch.setattr(ctx, "target_platform", lambda: "tpu")
+    fused = sequence_ops.gru(
+        ctx, {"Input": [xg], "Weight": [wg], "Length": [lengths]},
+        {"is_reverse": True})["Hidden"][0]
+    ctx2 = reg.EmitContext(jax.random.PRNGKey(0), is_test=True)
+    scan = sequence_ops.gru(
+        ctx2, {"Input": [xg], "Weight": [wg], "Length": [lengths]},
+        {"is_reverse": True})["Hidden"][0]
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(scan),
+                               atol=2e-5)
