@@ -139,10 +139,14 @@ def sequence_embedding(input, size, padding_idx=None, param_attr=None,
 
 
 def dynamic_lstm(input, size, h0=None, c0=None, param_attr=None,
-                 bias_attr=None, is_reverse=False, gate_activation="sigmoid",
-                 cell_activation="tanh", candidate_activation="tanh"):
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh"):
     """fluid nn.py dynamic_lstm: `input` is [B,T,4H] (pre-projected by an fc
-    of size 4H); returns (hidden [B,T,H], cell [B,T,H])."""
+    of size 4H); returns (hidden [B,T,H], cell [B,T,H]).  use_peepholes
+    grows the bias to [7H] = [4H gate bias, W_ic, W_fc, W_oc]
+    (lstm_op.cc's peephole packing; default off here — the reference fluid
+    default is on, but a 7H bias changes checkpoint shapes)."""
     helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
                          bias_attr=bias_attr)
     length = get_length_var(input)
@@ -152,7 +156,8 @@ def dynamic_lstm(input, size, h0=None, c0=None, param_attr=None,
         shape=[H, 4 * H], dtype=input.dtype)
     bias = helper.create_parameter(
         attr=bias_attr if isinstance(bias_attr, dict) else {},
-        shape=[4 * H], dtype=input.dtype, is_bias=True)
+        shape=[7 * H if use_peepholes else 4 * H], dtype=input.dtype,
+        is_bias=True)
     hidden = helper.create_tmp_variable(
         input.dtype, shape=tuple(input.shape[:2]) + (H,))
     cell = helper.create_tmp_variable(
@@ -166,7 +171,9 @@ def dynamic_lstm(input, size, h0=None, c0=None, param_attr=None,
     helper.append_op(
         "lstm", inputs=ins,
         outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
-        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+        attrs={"is_reverse": is_reverse,
+               "use_peepholes": bool(use_peepholes),
+               "gate_activation": gate_activation,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation},
     )

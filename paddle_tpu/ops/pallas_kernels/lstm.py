@@ -120,6 +120,8 @@ def usable(x_proj, attrs) -> bool:
     H = H4 // 4
     if not kernels_enabled():
         return False
+    if attrs.get("use_peepholes"):
+        return False  # peephole terms live only in the scan path
     if attrs.get("gate_activation", "sigmoid") != "sigmoid":
         return False
     if attrs.get("cell_activation", "tanh") != "tanh":

@@ -172,25 +172,31 @@ def masked_seq_mean(ctx, ins, attrs):
 
 
 def _lstm_scan(x_proj, h0, c0, w_h, lengths, gate_act, cell_act, cand_act,
-               reverse=False):
+               reverse=False, peep=None):
     """x_proj [B,T,4H] (input already projected), w_h [H,4H].
-    Paddle gate layout (lstm_op.cc): i, f, c̃, o chunks."""
+    Paddle gate layout (lstm_op.cc): i, f, c̃, o chunks.  `peep` =
+    (W_ic, W_fc, W_oc) adds the peephole terms of lstm_kernel.h:
+    i/f gates see c_{t-1}, the o gate sees c_t — all pre-activation."""
     import jax
     import jax.numpy as jnp
 
     B, T, H4 = x_proj.shape
     H = H4 // 4
     m = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x_proj.dtype)
+    w_ic, w_fc, w_oc = peep if peep is not None else (None, None, None)
 
     def step(carry, t):
         h, c = carry
         idx = T - 1 - t if reverse else t
         g = x_proj[:, idx] + h @ w_h
-        i = gate_act(g[:, :H])
-        f = gate_act(g[:, H: 2 * H])
+        gi = g[:, :H] + (c * w_ic if w_ic is not None else 0.0)
+        gf = g[:, H: 2 * H] + (c * w_fc if w_fc is not None else 0.0)
+        i = gate_act(gi)
+        f = gate_act(gf)
         ct = cand_act(g[:, 2 * H: 3 * H])
-        o = gate_act(g[:, 3 * H:])
         c_new = f * c + i * ct
+        go = g[:, 3 * H:] + (c_new * w_oc if w_oc is not None else 0.0)
+        o = gate_act(go)
         h_new = o * cell_act(c_new)
         mt = m[:, idx][:, None]
         h_new = mt * h_new + (1 - mt) * h
@@ -218,7 +224,8 @@ def _acts():
              non_diff_outputs=("Cell",))
 def lstm(ctx, ins, attrs):
     """dynamic_lstm (operators/lstm_op.cc): Input [B,T,4H] pre-projected,
-    Weight [H,4H], Bias [4H] (+peephole ignored for now)."""
+    Weight [H,4H], Bias [4H] — or [7H] with use_peepholes
+    (= [4H gate bias, W_ic, W_fc, W_oc], the lstm_op.cc packing)."""
     import jax.numpy as jnp
 
     acts = _acts()
@@ -229,6 +236,16 @@ def lstm(ctx, ins, attrs):
         else None
     B = x.shape[0]
     H = w.shape[0]
+    peep = None
+    if attrs.get("use_peepholes"):
+        if bias is None or bias.shape[-1] < 7 * H:
+            raise ValueError(
+                f"lstm: use_peepholes needs a [7H]={7 * H} bias "
+                f"([4H gate bias, W_ic, W_fc, W_oc]); got "
+                f"{None if bias is None else bias.shape} — a silent "
+                f"fallback would compute a plain LSTM under peephole "
+                f"semantics")
+        peep = (bias[4 * H:5 * H], bias[5 * H:6 * H], bias[6 * H:7 * H])
     if bias is not None:
         x = x + bias[: 4 * H][None, None, :]
     h0 = jnp.zeros((B, H), x.dtype)
@@ -268,6 +285,7 @@ def lstm(ctx, ins, attrs):
         acts[attrs.get("cell_activation", "tanh")],
         acts[attrs.get("candidate_activation", "tanh")],
         reverse=bool(attrs.get("is_reverse", False)),
+        peep=peep,
     )
     return {"Hidden": [hs], "Cell": [cs]}
 

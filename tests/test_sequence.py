@@ -168,3 +168,32 @@ def test_understand_sentiment_static_lstm_unit():
             fetch_list=[loss, acc])
         accs.append(float(a.item()))
     assert accs[-1] > 0.9, accs
+
+
+def test_dynamic_lstm_peepholes():
+    """use_peepholes grows the bias to 7H and feeds the lstm_kernel.h
+    peephole terms (i/f gates see c_prev, o gate sees c_new): zero
+    peephole weights reproduce the plain LSTM, nonzero ones change it."""
+    H = 16
+    x = fluid.layers.sequence_data("pp_x", shape=[4 * H], dtype="float32")
+    hidden, cell = fluid.layers.dynamic_lstm(x, size=4 * H,
+                                             use_peepholes=True)
+    pooled = fluid.layers.sequence_pool(hidden, pool_type="last")
+    out = fluid.layers.mean(pooled)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    blk = fluid.default_main_program().global_block()
+    bname = [v.name for v in blk.vars.values()
+             if getattr(v, "persistable", False) and v.shape == (7 * H,)]
+    assert bname, "7H peephole bias parameter missing"
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(t_, 4 * H).astype(np.float32) * 0.2 for t_ in (5, 3)]
+    feed = {"pp_x": LoDTensor.from_sequences(seqs)}
+    (v0,) = exe.run(feed=feed, fetch_list=[out])
+    # nonzero peephole weights must change the forward value
+    scope = fluid.global_scope()
+    scope.set(bname[0], np.concatenate(
+        [np.zeros(4 * H, np.float32), np.full(3 * H, 0.5, np.float32)]))
+    (v1,) = exe.run(feed=feed, fetch_list=[out])
+    a, b = (float(np.asarray(v).reshape(())) for v in (v0, v1))
+    assert abs(a - b) > 1e-6, (a, b)
