@@ -148,11 +148,15 @@ def accuracy(ctx, ins, attrs):
     pred_idx = ins["Indices"][0]  # [N, k] from top_k
     label = ins["Label"][0].reshape(-1, 1)
     correct = jnp.any(pred_idx == label, axis=1)
-    n = jnp.asarray([pred_idx.shape[0]], dtype=jnp.int64)
+    # count dtype: int64 when x64 is on (tests), else int32 — requesting
+    # int64 with x64 off only buys a per-step truncation warning
+    idt = jnp.asarray(1).dtype if jnp.asarray(1).dtype == jnp.int64 \
+        else jnp.int32
+    n = jnp.asarray([pred_idx.shape[0]], dtype=idt)
     c = jnp.sum(correct.astype(jnp.float32))
     return {
         "Accuracy": [(c / pred_idx.shape[0]).reshape((1,))],
-        "Correct": [c.astype(jnp.int64).reshape((1,))],
+        "Correct": [c.astype(idt).reshape((1,))],
         "Total": [n],
     }
 
