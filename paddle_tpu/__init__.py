@@ -18,6 +18,30 @@ Fluid-style usage (mirrors python/paddle/v2/fluid/__init__.py):
 Programs are desc graphs (framework/core.py); execution compiles whole blocks
 to XLA (framework/executor.py)."""
 
+
+def _honor_jax_platforms_env():
+    """An explicit JAX_PLATFORMS env var wins over any platform a site
+    plugin force-selected via jax.config (some TPU plugins pin their
+    platform at interpreter startup, which would beat the env var and make
+    JAX_PLATFORMS=cpu hang on a wedged accelerator tunnel).  Mirrors the
+    env var into jax.config before the first backend init — the
+    package-wide version of capi_runtime.py's guarantee."""
+    import os
+
+    plats = os.environ.get("JAX_PLATFORMS")
+    if not plats:
+        return
+    try:
+        import jax
+
+        if jax.config.jax_platforms != plats:
+            jax.config.update("jax_platforms", plats)
+    except Exception:  # config may be sealed post-init; env took effect then
+        pass
+
+
+_honor_jax_platforms_env()
+
 from . import layers  # noqa: F401
 from . import ops  # noqa: F401  (registers all op emitters)
 from . import optimizer  # noqa: F401
@@ -36,6 +60,7 @@ from .data_feeder import DataFeeder, DeviceFeeder  # noqa: F401
 from .lod import LoDTensor  # noqa: F401
 Tensor = LoDTensor  # reference fluid alias (__init__.py Tensor)
 from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
+from .inference_transpiler import InferenceTranspiler, fuse_batch_norm  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
 from .framework import backward  # noqa: F401

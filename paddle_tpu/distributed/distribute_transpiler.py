@@ -98,6 +98,16 @@ class DistributeTranspiler:
                 static = _static_lr(lr, startup_program)  # init-op value
                 if static is not None:
                     cfg["lr"] = static
+                if lr is not None:
+                    # a schedule's LR is a tmp var the executor would
+                    # discard; persist it so the updater can read the
+                    # CURRENT value each step and forward it to the host
+                    # optimizers (step()._sync_lrs) — otherwise a decaying
+                    # schedule runs in the trainer while the servers keep
+                    # the initial LR forever
+                    lr_var = block._find_var_recursive(lr)
+                    if lr_var is not None:
+                        lr_var.persistable = True
                 self.param_cfg[pname] = cfg
                 self.param_grad[pname] = op.inputs["Grad"][0]
             else:
@@ -157,21 +167,35 @@ class RemoteUpdater:
         self.t = transpiler
         self.scope = scope or global_scope()
         self.client = ParameterClient(self.t.endpoints, self.t.trainer_id)
+        # last LR sent to the service per param: step() re-sends when the
+        # scope's LR var moves (decay schedules run in the trainer program;
+        # the host optimizers must follow — ADVICE r2 medium).  Starts
+        # empty so the first step always syncs; cleared whenever the client
+        # reconnects, because a pserver restarted from a checkpoint holds
+        # the LR as of the checkpoint, not as of our last send.
+        self._last_lr: Dict[str, float] = {}
+        self._lr_epoch = self.client.reconnect_epoch
 
-    def _lr_of(self, cfg) -> float:
+    def _lr_of(self, cfg, allow_missing: bool = False):
         lr_var = cfg.get("_lr_var")
         if lr_var is None:
             return cfg.get("lr", 0.01)  # no LR var on the op
         v = self.scope.find(lr_var)
-        if v is None and "lr" in cfg:
+        if v is not None:
+            return float(np.asarray(v).reshape(-1)[0])
+        if "lr" in cfg:
             return cfg["lr"]  # constant resolved at transpile time
-        if v is None:
-            raise RuntimeError(
-                f"learning-rate var {lr_var!r} not found in the updater's "
-                f"scope — run the startup program into this scope before "
-                f"init_params() (a silent default would override the "
-                f"configured LR)")
-        return float(np.asarray(v).reshape(-1)[0])
+        if allow_missing:
+            # LR-schedule var with no value yet (the schedule computes it
+            # during the first main-program run): the caller defers —
+            # step()._sync_lrs delivers the real value before the first
+            # gradient is applied
+            return None
+        raise RuntimeError(
+            f"learning-rate var {lr_var!r} not found in the updater's "
+            f"scope — run the startup program into this scope before "
+            f"init_params() (a silent default would override the "
+            f"configured LR)")
 
     def init_params(self, timeout_s: float = 120.0):
         """paddle_begin_init_params flow: only trainer 0 seeds values
@@ -202,7 +226,9 @@ class RemoteUpdater:
                         f"parameter {pname!r} not initialized in the "
                         f"updater's scope — run the startup program first")
                 rule = {k: v for k, v in cfg.items() if k != "_lr_var"}
-                rule["lr"] = self._lr_of(cfg)
+                lr = self._lr_of(cfg, allow_missing=True)
+                if lr is not None:
+                    rule["lr"] = lr
                 self.client.init_param(pname, value, rule)
             self.client.finish_init_params()
         else:
@@ -215,9 +241,13 @@ class RemoteUpdater:
                 time.sleep(0.05)
             self.pull_params()
 
-    def step(self, grads: Dict[str, np.ndarray]):
+    def step(self, grads: Dict[str, np.ndarray], strict: bool = False):
         """One remote update round: push this trainer's grads (keyed by
-        param OR grad name), then refresh local params."""
+        param OR grad name), sync any moved learning rates, then refresh
+        local params.  `strict=True` raises instead of warning when an
+        expected gradient is absent."""
+        import logging
+
         by_param = {}
         known = set()
         for pname, gname in self.t.param_grad.items():
@@ -232,17 +262,46 @@ class RemoteUpdater:
         # matched would still consume a BSP round, reject that outright
         stray = set(grads) - known
         if stray:
-            import logging
             logging.getLogger(__name__).warning(
                 "RemoteUpdater.step: ignoring grads keys %s (no matching "
                 "transpiled param/grad; expected among %s)",
                 sorted(stray), sorted(known))
+        # the symmetric hole (ADVICE r2): an EXPECTED gradient that never
+        # arrives leaves its parameter silently frozen on the server
+        absent = set(self.t.param_grad) - set(by_param)
+        if absent:
+            msg = (f"RemoteUpdater.step: no gradient for transpiled "
+                   f"param(s) {sorted(absent)} in this round — they will "
+                   f"not be updated")
+            if strict:
+                raise KeyError(msg)
+            logging.getLogger(__name__).warning(msg)
         if known and not by_param:
             raise KeyError(
                 f"step() grads keys {sorted(grads)} match no transpiled "
                 f"param/grad name (expected any of {sorted(known)})")
+        self._sync_lrs()
         self.client.send_grads(by_param)
         self.pull_params()
+
+    def _sync_lrs(self):
+        """Re-send each param's CURRENT learning rate when it differs from
+        the last value this trainer pushed (first step always syncs): LR
+        schedules evaluate in the trainer program, and a frozen server-side
+        LR would silently diverge from single-process semantics."""
+        if self.client.reconnect_epoch != self._lr_epoch:
+            # the far side may have restarted from a checkpoint whose LR
+            # predates our last send — re-sync everything
+            self._lr_epoch = self.client.reconnect_epoch
+            self._last_lr.clear()
+        changed = {}
+        for pname, cfg in self.t.param_cfg.items():
+            lr = self._lr_of(cfg, allow_missing=True)
+            if lr is not None and self._last_lr.get(pname) != lr:
+                changed[pname] = lr
+        if changed:
+            self.client.update_lrs(changed)
+            self._last_lr.update(changed)
 
     def pull_params(self):
         for pname in self.t.param_cfg:

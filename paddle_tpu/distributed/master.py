@@ -248,9 +248,13 @@ class MasterServer:
 
 class MasterClient:
     """Trainer-side client (go/master/client.go + python v2/master/client.py
-    :28/:70) with reconnect-on-error."""
+    :28/:70) with reconnect-on-error.  Safe for concurrent use: `call` is
+    serialized by an internal lock — the per-nonce seq tokens and the
+    framed socket protocol both assume one in-flight request per client
+    (ADVICE r2)."""
 
     def __init__(self, addr, retries: int = 3):
+        import threading
         import uuid
 
         self.addr = tuple(addr)
@@ -259,12 +263,17 @@ class MasterClient:
         self._file = None
         self._nonce = uuid.uuid4().hex[:12]
         self._seq = 0
+        self._lock = threading.Lock()
 
     def _connect(self):
         self._sock = socket.create_connection(self.addr, timeout=30)
         self._file = self._sock.makefile("rwb")
 
     def call(self, method, *args):
+        with self._lock:
+            return self._call_locked(method, *args)
+
+    def _call_locked(self, method, *args):
         last = None
         self._seq += 1
         seq = f"{self._nonce}:{self._seq}"  # same token on every retry

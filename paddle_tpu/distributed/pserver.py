@@ -225,6 +225,24 @@ class ParameterServerService:
         with self._lock:
             self._init_done = True
 
+    def update_lrs(self, lrs: Dict[str, float]):
+        """Refresh host-optimizer learning rates mid-training (ADVICE r2
+        medium: an LR schedule decaying in the trainer program must reach
+        the server-side optimizers or distributed training silently
+        diverges from single-process semantics).  Idempotent — no seq
+        dedup needed; names this shard doesn't own are ignored (each
+        trainer broadcasts the full schedule)."""
+        with self._lock:
+            for name, lr in lrs.items():
+                opt = self._opts.get(name)
+                if opt is None:
+                    continue
+                opt.lr = float(lr)
+                # keep the persisted rule in sync so a checkpoint restart
+                # resumes with the decayed LR, not the initial one
+                if name in self._opt_cfgs:
+                    self._opt_cfgs[name]["lr"] = float(lr)
+
     def initialized(self) -> bool:
         with self._lock:
             return self._init_done
@@ -502,6 +520,9 @@ class _PServerHandler(socketserver.BaseRequestHandler):
             svc.send_sparse_grad(header["trainer_id"], header["name"],
                                  rows, values, seq=header.get("seq"))
             return {"ok": True}, b""
+        if op == "update_lr":
+            svc.update_lrs(header["lrs"])
+            return {"ok": True}, b""
         if op == "get_param":
             desc, out = _pack_array(svc.get_param(header["name"]))
             return {"ok": True, "array": desc}, out
@@ -611,6 +632,11 @@ class ParameterClient:
 
         self._nonce = uuid.uuid4().hex[:12]
         self._seq = 0
+        # bumped whenever a dead socket is dropped (= the far side may have
+        # restarted from a checkpoint with stale derived state): consumers
+        # holding send-once caches keyed on server state (RemoteUpdater's
+        # _last_lr) re-sync when this moves
+        self.reconnect_epoch = 0
 
     def _next_seq(self) -> str:
         self._seq += 1
@@ -641,6 +667,7 @@ class ParameterClient:
                 reply, out = _recv_msg(sock)
             except (OSError, ConnectionError) as e:
                 last = e
+                self.reconnect_epoch += 1
                 dead = self._socks.pop(endpoint, None)
                 if dead is not None:
                     try:
@@ -690,6 +717,14 @@ class ParameterClient:
                             "trainer_id": self.trainer_id,
                             "seq": self._next_seq(),
                             "arrays": descs}, b"".join(chunks))
+
+    def update_lrs(self, lrs: Dict[str, float]):
+        """Push fresh learning rates to the servers owning each param."""
+        by_server: Dict[str, dict] = {}
+        for name, lr in lrs.items():
+            by_server.setdefault(self._server_for(name), {})[name] = float(lr)
+        for ep, batch in by_server.items():
+            self._call(ep, {"op": "update_lr", "lrs": batch})
 
     def send_sparse_grad(self, name, rows, values):
         rd, rb = _pack_array(np.asarray(rows))

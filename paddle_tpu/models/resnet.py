@@ -54,11 +54,24 @@ def bottleneck(input, ch_in, ch_out, stride, layout="NCHW"):
 
 
 def layer_warp(block_func, input, ch_in, ch_out, count, stride,
-               layout="NCHW"):
-    res = block_func(input, ch_in, ch_out, stride, layout=layout)
+               layout="NCHW", remat=False):
+    """`remat=True` wraps every residual block in layers.recompute()
+    (jax.checkpoint): the block's activations are rematerialized in the
+    backward pass instead of stored — the roofline doc
+    (docs/perf_resnet50_roofline.md) measured 12.9 GB/step of fusion
+    writes on the bs128 bench config while compute sat 4.5x under the HBM
+    bound, exactly the trade remat makes."""
+    import contextlib
+
+    def scope():
+        return layers.recompute() if remat else contextlib.nullcontext()
+
+    with scope():
+        res = block_func(input, ch_in, ch_out, stride, layout=layout)
     for _ in range(1, count):
         ch_in_cur = ch_out * (4 if block_func is bottleneck else 1)
-        res = block_func(res, ch_in_cur, ch_out, 1, layout=layout)
+        with scope():
+            res = block_func(res, ch_in_cur, ch_out, 1, layout=layout)
     return res
 
 
@@ -71,7 +84,8 @@ _DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, layout="NCHW"):
+def resnet_imagenet(input, class_dim=1000, depth=50, layout="NCHW",
+                    remat=False):
     """Reference resnet.py ImageNet topology (224x224)."""
     block, counts = _DEPTH_CFG[depth]
     expansion = 4 if block is bottleneck else 1
@@ -80,13 +94,14 @@ def resnet_imagenet(input, class_dim=1000, depth=50, layout="NCHW"):
     pool1 = layers.pool2d(input=conv1, pool_size=3, pool_stride=2,
                           pool_padding=1, pool_type="max",
                           data_format=layout)
-    res1 = layer_warp(block, pool1, 64, 64, counts[0], 1, layout=layout)
+    res1 = layer_warp(block, pool1, 64, 64, counts[0], 1, layout=layout,
+                      remat=remat)
     res2 = layer_warp(block, res1, 64 * expansion, 128, counts[1], 2,
-                      layout=layout)
+                      layout=layout, remat=remat)
     res3 = layer_warp(block, res2, 128 * expansion, 256, counts[2], 2,
-                      layout=layout)
+                      layout=layout, remat=remat)
     res4 = layer_warp(block, res3, 256 * expansion, 512, counts[3], 2,
-                      layout=layout)
+                      layout=layout, remat=remat)
     pool2 = layers.pool2d(input=res4, pool_size=7, pool_type="avg",
                           global_pooling=True, data_format=layout)
     logits = layers.fc(input=pool2, size=class_dim)
@@ -108,13 +123,16 @@ def resnet_cifar10(input, class_dim=10, depth=32, layout="NCHW"):
 
 def build_train_program(batch_size=64, depth=50, class_dim=1000,
                         image_shape=(3, 224, 224), dtype="float32",
-                        learning_rate=0.1, momentum=0.9, layout="NCHW"):
+                        learning_rate=0.1, momentum=0.9, layout="NCHW",
+                        remat=False):
     """Full training program: returns (avg_cost, accuracy).
 
     With dtype='bfloat16' the conv/GEMM path runs natively on the MXU; the
     softmax/loss head is computed in float32 for stability.  With
     layout='NHWC' the 'image' feed is expected channels-last
-    ([H, W, C])."""
+    ([H, W, C]).  `remat=True` checkpoints every residual block (see
+    layer_warp) — the HBM-traffic lever for the bandwidth-bound train
+    step."""
     import paddle_tpu as fluid
 
     # image_shape is always the reference's CHW spec; NHWC transposes the
@@ -125,7 +143,7 @@ def build_train_program(batch_size=64, depth=50, class_dim=1000,
     img = layers.data(name="image", shape=shape, dtype=dtype)
     label = layers.data(name="label", shape=[1], dtype="int64")
     logits = resnet_imagenet(img, class_dim=class_dim, depth=depth,
-                             layout=layout)
+                             layout=layout, remat=remat)
     logits32 = layers.cast(logits, "float32") if dtype != "float32" else logits
     loss = layers.softmax_with_cross_entropy(logits32, label)
     avg_cost = layers.mean(loss)

@@ -335,9 +335,19 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
     return rs(dq), rs(dk), rs(dv)
 
 
+_TRAIN_CACHE = {}
+
+
 def make_flash_train(causal: bool = False, scale=None, interpret=False):
     """custom_vjp fused attention for TRAINING (honored by generic_grad's
-    jax.vjp like the recurrence kernels)."""
+    jax.vjp like the recurrence kernels).  Memoized per
+    (causal, scale, interpret): emitters call this on every trace, and a
+    fresh wrapper each time would defeat jit's function-identity caching
+    (ADVICE r2)."""
+    key = (causal, scale, interpret)
+    cached = _TRAIN_CACHE.get(key)
+    if cached is not None:
+        return cached
     import jax
 
     @jax.custom_vjp
@@ -357,4 +367,5 @@ def make_flash_train(causal: bool = False, scale=None, interpret=False):
                                    scale=scale, interpret=interpret)
 
     attn.defvjp(fwd, bwd)
+    _TRAIN_CACHE[key] = attn
     return attn

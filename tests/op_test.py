@@ -54,9 +54,15 @@ class OpTestHarness:
         out_desc = {}
         out_vars = {}
         for slot in self.out_slots:
-            v = block.create_var(name=f"out_{slot}", dtype=None, shape=None)
-            out_desc[slot] = [v.name]
-            out_vars[slot] = v
+            # a slot is either "Name" (one var) or ("Name", n) for ops whose
+            # emitter yields a list (split); out_vars keeps the FIRST var so
+            # check_grad's loss head stays unchanged
+            slot, n = slot if isinstance(slot, tuple) else (slot, 1)
+            vs = [block.create_var(name=f"out_{slot}_{i}" if n > 1
+                                   else f"out_{slot}", dtype=None, shape=None)
+                  for i in range(n)]
+            out_desc[slot] = [v.name for v in vs]
+            out_vars[slot] = vs[0]
         block.append_op(self.op_type, inputs=in_desc, outputs=out_desc,
                         attrs=dict(self.attrs))
         return prog, in_desc, out_vars
@@ -92,7 +98,8 @@ class OpTestHarness:
         exe = fluid.Executor(fluid.CPUPlace())
         scope = fluid.global_scope()
         self._scope_feed(scope)
-        slots = slots or self.out_slots
+        slots = [s[0] if isinstance(s, tuple) else s
+                 for s in (slots or self.out_slots)]
         return exe.run(prog, feed={}, fetch_list=[out_vars[s] for s in slots])
 
     # ------------------------------------------------------------------

@@ -178,3 +178,92 @@ def test_elastic_pserver_restart_mid_training(tmp_path):
         upd.close()
     finally:
         srv2.stop()
+
+
+def test_lr_schedule_reaches_host_optimizers():
+    """ADVICE r2 (medium): a decaying LR schedule runs in the trainer
+    program; each step() must forward the CURRENT value to the server-side
+    optimizers — a frozen init-time LR silently diverges from
+    single-process semantics."""
+    from paddle_tpu import learning_rate_decay
+
+    rng = np.random.RandomState(0)
+    x = layers.data("lrx", shape=[4], dtype="float32")
+    y = layers.data("lry", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    lr = learning_rate_decay.exponential_decay(
+        learning_rate=0.5, decay_steps=1, decay_rate=0.5, staircase=True)
+    fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(cost)
+
+    svc, srv, ep = _start_pserver()
+    try:
+        t = fluid.DistributeTranspiler().transpile(0, pservers=ep,
+                                                   trainers=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        upd = t.make_updater()
+        upd.init_params()  # schedule LR not computed yet: rule ships bare
+        gvars = t.grad_fetch_list()
+        gnames = [g.name for g in gvars]
+        seen = []
+        for _ in range(3):
+            xv = rng.rand(8, 4).astype(np.float32)
+            yv = (xv @ np.ones((4, 1))).astype(np.float32)
+            outs = exe.run(feed={"lrx": xv, "lry": yv},
+                           fetch_list=[cost] + gvars)
+            upd.step(dict(zip(gnames, outs[1:])))
+            seen.append({p: svc._opts[p].lr for p in t.param_cfg})
+        # exponential_decay(0.5, decay 0.5/step, staircase): the global
+        # step is incremented BEFORE the lr computes, so the first run
+        # yields 0.25, then 0.125, 0.0625
+        for pname in t.param_cfg:
+            got = [s[pname] for s in seen]
+            np.testing.assert_allclose(got, [0.25, 0.125, 0.0625],
+                                       rtol=1e-6)
+        upd.close()
+    finally:
+        srv.stop()
+
+
+def test_step_warns_on_missing_expected_grad(caplog):
+    """ADVICE r2 (low): an expected gradient that never arrives leaves its
+    parameter frozen server-side — warn, and raise under strict=True."""
+    import logging
+    import pytest
+
+    rng = np.random.RandomState(0)
+    x = layers.data("mgx", shape=[4], dtype="float32")
+    y = layers.data("mgy", shape=[1], dtype="float32")
+    h = layers.fc(x, size=3)
+    pred = layers.fc(h, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+
+    svc, srv, ep = _start_pserver()
+    try:
+        t = fluid.DistributeTranspiler().transpile(0, pservers=ep,
+                                                   trainers=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        upd = t.make_updater()
+        upd.init_params()
+        gvars = t.grad_fetch_list()
+        gnames = [g.name for g in gvars]
+        xv = rng.rand(8, 4).astype(np.float32)
+        yv = rng.rand(8, 1).astype(np.float32)
+        outs = exe.run(feed={"mgx": xv, "mgy": yv},
+                       fetch_list=[cost] + gvars)
+        grads = dict(zip(gnames, outs[1:]))
+        dropped = gnames[0]
+        partial = {k: v for k, v in grads.items() if k != dropped}
+        with caplog.at_level(logging.WARNING):
+            upd.step(partial)
+        assert any("no gradient for transpiled param" in r.message
+                   for r in caplog.records)
+        with pytest.raises(KeyError, match="no gradient for transpiled"):
+            upd.step(partial, strict=True)
+        upd.step(grads)  # full rounds still work
+        upd.close()
+    finally:
+        srv.stop()

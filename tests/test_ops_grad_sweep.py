@@ -482,3 +482,71 @@ def test_dropout_grad_deterministic_rng():
                    "dropout_implementation": "upscale_in_train"},
                   out_slots=["Out", "Mask"]).check_grad(
         ["X"], output_slot="Out")
+
+
+# ------------------------------------------- round-3 additions (VERDICT #6)
+# ops previously excluded only by prose; each is numerically checkable with
+# inputs placed away from its kinks/ties
+
+def test_clip_grad():
+    x = _away_from(_r(3, 5, lo=-1.5, hi=1.5), [-0.7, 0.7])
+    OpTestHarness("clip", {"X": x}, {"min": -0.7, "max": 0.7}).check_grad(
+        ["X"])
+
+
+def test_cast_grad():
+    # f64 -> f32 cast: gradient is the identity cast back; larger eps rides
+    # above f32 rounding noise in the numeric difference
+    x = _r(3, 4)
+    OpTestHarness("cast", {"X": x}, {"out_dtype": "float32"}).check_grad(
+        ["X"], eps=1e-3, max_relative_error=1e-2)
+
+
+def test_split_grad():
+    # loss reads section 0 only: cotangent flows into it, zeros into the
+    # other section — checks the vjp wiring including the unfetched-output
+    # zero-cotangent path
+    x = _r(4, 6)
+    OpTestHarness("split", {"X": x}, {"num": 2, "axis": 1},
+                  out_slots=[("Out", 2)]).check_grad(["X"])
+
+
+def test_sequence_reshape_grad():
+    x = _r(2, 4, 6)
+    lengths = np.array([4, 3], np.int32)
+    OpTestHarness("sequence_reshape", {"X": x, "Length": lengths},
+                  {"new_dim": 8},
+                  out_slots=["Out", "LengthOut"]).check_grad(["X"])
+
+
+def test_max_pool2d_with_index_grad():
+    # distinct values: argmax ties would make the numeric derivative
+    # ill-defined under perturbation
+    rng = np.random.RandomState(7)
+    x = rng.permutation(2 * 3 * 6 * 6).astype(np.float64).reshape(2, 3, 6, 6)
+    x /= x.size
+    OpTestHarness("max_pool2d_with_index", {"X": x},
+                  {"ksize": [2, 2], "strides": [2, 2]},
+                  out_slots=["Out", "Mask"]).check_grad(
+        ["X"], eps=1e-4, max_relative_error=1e-2)
+
+
+def test_batch_norm_grad():
+    # training-mode BN: batch stats; emitter computes in f32, so eps and
+    # tolerance sit above f32 arithmetic noise
+    rng = np.random.RandomState(3)
+    C = 4
+    x = rng.randn(6, C, 3, 3).astype(np.float64)
+    scale = rng.rand(C).astype(np.float64) + 0.5
+    bias = rng.randn(C).astype(np.float64) * 0.1
+    mean = np.zeros(C, np.float64)
+    var = np.ones(C, np.float64)
+    OpTestHarness(
+        "batch_norm",
+        {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+         "Variance": var},
+        {"epsilon": 1e-5, "momentum": 0.9},
+        out_slots=["Y", "MeanOut", "VarianceOut", "SavedMean",
+                   "SavedVariance"],
+    ).check_grad(["X", "Scale", "Bias"], output_slot="Y", eps=1e-3,
+                 max_relative_error=3e-2)
