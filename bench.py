@@ -199,7 +199,8 @@ def bench_resnet_infer(warmup, iters):
     # deployment-path graph: fold BN into conv weights (merge_model
     # analog; numerics covered by test_inference_transpiler) —
     # BENCH_NO_BNFOLD=1 opts out for A/B runs
-    if not os.environ.get("BENCH_NO_BNFOLD"):
+    bnfold = not os.environ.get("BENCH_NO_BNFOLD")
+    if bnfold:
         fluid.fuse_batch_norm(fluid.default_main_program(),
                               fluid.global_scope())
 
@@ -211,7 +212,8 @@ def bench_resnet_infer(warmup, iters):
     dt = _timed_loop(exe, feed, prob, warmup, iters)
     img_s = bs / dt
     return {
-        "metric": f"resnet{depth}_infer_img_per_s_{dtype}_bs{bs}",
+        "metric": f"resnet{depth}_infer_img_per_s_{dtype}_bs{bs}"
+                  f"{'_bnfold' if bnfold else ''}",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / RESNET_INFER_BASE, 2),
@@ -346,11 +348,22 @@ def main():
         "lstm": bench_lstm_train,
         "infer": bench_resnet_infer,
     }
+    def finish(result):
+        """The executor may have self-healed a Mosaic failure mid-run
+        (runtime_disable): the numbers are then XLA-fallback, and saying
+        so is the whole point of the annotation contract."""
+        from paddle_tpu.ops.pallas_kernels import _common as _pk
+
+        if _pk._RUNTIME_DISABLED:
+            result["note"] = ("fused kernels disabled at runtime after "
+                              f"Mosaic failure: {_pk._RUNTIME_DISABLED}")
+        print(json.dumps(result))
+
     if model in ("alexnet", "googlenet", "vgg"):
-        print(json.dumps(bench_cnn_train(model, warmup, iters)))
+        finish(bench_cnn_train(model, warmup, iters))
         return
     if model != "all":
-        print(json.dumps(runners[model](warmup, iters)))
+        finish(runners[model](warmup, iters))
         return
 
     # total wall-clock budget: skip remaining modes rather than dying to an

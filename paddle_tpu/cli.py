@@ -12,6 +12,9 @@ Usage: python -m paddle_tpu <subcommand> [args]
   validate DIR|FILE     — structural check via the native desc library
   show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
+  master ...            — fault-tolerant task-dispatch service
+                          (distributed/master; the Go master+etcd role,
+                          with a file snapshot as the etcd replacement)
 """
 
 from __future__ import annotations
@@ -146,6 +149,21 @@ def cmd_pserver(args) -> int:
     return 0
 
 
+def cmd_master(args) -> int:
+    from .distributed.master import MasterServer, MasterService
+
+    svc = MasterService(timeout_s=args.task_timeout,
+                        failure_max=args.failure_max,
+                        snapshot_path=args.snapshot)
+    srv = MasterServer(svc, host=args.host, port=args.port).start()
+    print(f"master serving on {srv.addr[0]}:{srv.addr[1]}", flush=True)
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="paddle", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -182,6 +200,15 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-period", type=float, default=600.0)
     p.set_defaults(fn=cmd_pserver)
+
+    p = sub.add_parser("master")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--task-timeout", type=float, default=60.0)
+    p.add_argument("--failure-max", type=int, default=3)
+    p.add_argument("--snapshot", default=None,
+                   help="task-queue snapshot file (restart recovery)")
+    p.set_defaults(fn=cmd_master)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -296,6 +296,9 @@ class MasterClient:
                 time.sleep(0.1)
         raise ConnectionError(f"master unreachable: {last}")
 
+    def set_dataset(self, payloads):
+        return self.call("set_dataset", list(payloads))
+
     def get_task(self, trainer_id=""):
         return self.call("get_task", trainer_id)
 

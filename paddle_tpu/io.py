@@ -94,8 +94,14 @@ def _strip_backward(program: Program, targets: List[str]) -> Program:
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=None, scope=None):
-    """io.py:222 equivalent: prune to targets, save program + persistables."""
+                         main_program=None, scope=None,
+                         fold_batch_norm=False):
+    """io.py:222 equivalent: prune to targets, save program + persistables.
+
+    `fold_batch_norm=True` bakes inference-mode BN into conv weights
+    (InferenceTranspiler) before saving — the saved model carries the
+    folded weights; the live training scope is untouched (the fold writes
+    into a child scope overlay)."""
     program = main_program or default_main_program()
     target_names = [t.name if hasattr(t, "name") else t for t in target_vars]
     inference_program = _strip_backward(program, target_names)
@@ -103,6 +109,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     for op in inference_program.global_block().ops:
         if op.type in ("dropout", "batch_norm"):
             op.attrs["is_test"] = True
+    scope = scope or global_scope()
+    if fold_batch_norm:
+        from .inference_transpiler import fuse_batch_norm as _fuse
+
+        scope = scope.new_scope()  # folded weights mask the originals
+        _fuse(inference_program, scope)
     os.makedirs(dirname, exist_ok=True)
     meta = {
         "feed_var_names": list(feeded_var_names),
@@ -135,12 +147,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
             f.write(model_bytes)
     with open(os.path.join(dirname, "meta.json"), "w") as f:
         json.dump(meta, f)
-    scope = scope or global_scope()
     used = set()
     for op in inference_program.global_block().ops:
         used.update(op.input_names())
-    names = [n for n in persistable_names(program)
-             if n in used and scope.has(n)]
+    # union with the inference program's own persistables: the BN fold
+    # introduces bias vars that exist only there
+    pnames = dict.fromkeys(persistable_names(program))
+    pnames.update(dict.fromkeys(persistable_names(inference_program)))
+    names = [n for n in pnames if n in used and scope.has(n)]
     save_vars(dirname, names, scope)
     with open(os.path.join(dirname, "persistables.json"), "w") as f:
         json.dump(names, f)

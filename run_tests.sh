@@ -8,5 +8,9 @@ cd "$(dirname "$0")"
 
 python -m pytest tests/ -q "$@"
 
+# two-process multi-host smoke (jax.distributed + global-mesh
+# ParallelExecutor; opt-in marker in tests/test_multihost.py)
+PADDLE_TPU_MULTIHOST_TEST=1 python -m pytest tests/test_multihost.py -q
+
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

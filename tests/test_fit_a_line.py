@@ -59,3 +59,40 @@ def test_program_serialization_roundtrip():
     clone = fluid.Program.from_json(prog.to_json())
     assert clone.num_ops() == prog.num_ops()
     assert set(clone.global_block().vars) == set(prog.global_block().vars)
+
+
+def test_fit_a_line_real_table():
+    """Real-data acceptance for the fit-a-line regression (reference
+    book/test_fit_a_line.py trains real uci_housing to cost < 10): the
+    actual housing table is unreachable in this zero-egress environment,
+    so the same program trains on a REAL regression table this environment
+    ships — sklearn's diabetes corpus (442 genuine patient records,
+    10 features) — with the cost bar set by that table's noise floor."""
+    from sklearn.datasets import load_diabetes
+
+    d = load_diabetes()
+    xs = d.data.astype(np.float32)          # already zero-mean/scaled
+    ys = (d.target / d.target.max()).astype(np.float32).reshape(-1, 1)
+    print("[book] fit_a_line real-table mode: real "
+          "(sklearn.datasets.load_diabetes, 442 real patient records)")
+
+    x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bs = 64
+    losses = []
+    for epoch in range(60):
+        for i in range(0, len(xs), bs):
+            (loss,) = exe.run(feed={"x": xs[i:i + bs], "y": ys[i:i + bs]},
+                              fetch_list=[avg_cost])
+        losses.append(float(loss))
+    # a linear model explains ~half the variance of this table (R^2 ~0.5);
+    # var(y_scaled) ~ 0.06 -> converged MSE well under 0.05
+    assert losses[-1] < 0.05, f"did not converge: {losses[::10]}"
+    assert losses[-1] < losses[0]

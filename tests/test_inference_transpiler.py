@@ -68,6 +68,45 @@ def test_fuse_batch_norm_matches_unfused(layout, dtype):
                                atol=tol, rtol=tol)
 
 
+def test_save_inference_model_fold_batch_norm_roundtrip(tmp_path):
+    """save_inference_model(fold_batch_norm=True) ships folded weights in
+    the saved model, leaves the live scope untouched, and the loaded model
+    reproduces the unfolded outputs."""
+    out = _build("NCHW", "float32")
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    scope = fluid.global_scope()
+    for op in prog.global_block().ops:
+        if op.type == "batch_norm":
+            for slot in ("Mean", "Variance", "Scale", "Bias"):
+                name = op.inputs[slot][0]
+                C = np.asarray(scope.find_np(name)).shape[0]
+                val = (rng.rand(C) + 0.5 if slot == "Variance"
+                       else rng.randn(C) * 0.3).astype(np.float32)
+                scope.set(name, val)
+
+    feed = {"ftx": rng.rand(2, 3, 16, 16).astype(np.float32)}
+    (before,) = exe.run(prog, feed=feed, fetch_list=[out])
+    filt0 = prog.global_block().ops[0].inputs["Filter"][0]
+    w_live = np.asarray(scope.find_np(filt0)).copy()
+
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["ftx"], [out], exe,
+                                  fold_batch_norm=True)
+    # live scope untouched by the fold (child-scope overlay)
+    np.testing.assert_array_equal(np.asarray(scope.find_np(filt0)), w_live)
+
+    prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    assert not any(op.type == "batch_norm"
+                   for op in prog2.global_block().ops)
+    (after,) = exe.run(prog2, feed={feeds[0]: feed["ftx"]},
+                       fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_fuse_refuses_training_program():
     img = layers.data("ftr", shape=[3, 8, 8], dtype="float32")
     c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,

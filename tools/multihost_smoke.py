@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Two-process multi-host smoke (VERDICT r2 next-round #7).
+
+Proves the multi-host bring-up path end-to-end with no TPU pod: the parent
+spawns PADDLE_TRAINERS=2 local processes, each with 4 virtual CPU devices;
+each joins the job via distributed.launch.init_distributed
+(jax.distributed.initialize) and trains the SAME dp=8 step through
+ParallelExecutor over the GLOBAL mesh — the single-program SPMD shape that
+replaces the reference's fabric/k8s cluster_train launchers.
+
+Run:  python tools/multihost_smoke.py
+Exit 0 + "MULTIHOST SMOKE OK" when both processes agree on finite,
+decreasing losses.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 4
+LOCAL_DEVICES = 4
+
+
+def child(pid: int, n: int, coordinator: str):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+    os.environ["PADDLE_TRAINER_ID"] = str(pid)
+    os.environ["PADDLE_TRAINERS"] = str(n)
+    os.environ["PADDLE_COORDINATOR"] = coordinator
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import launch
+
+    assert launch.init_distributed()
+    import jax
+
+    assert jax.process_count() == n, jax.process_count()
+    world = len(jax.devices())
+    assert world == LOCAL_DEVICES * n, world
+
+    from paddle_tpu.parallel import ParallelExecutor
+
+    x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=64, act="relu")
+    logits = fluid.layers.fc(input=h, size=10)
+    avg = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+
+    pe = ParallelExecutor(axes={"dp": world})
+    pe.run(fluid.default_startup_program())
+
+    # every process feeds the IDENTICAL global batch (same seed);
+    # device_put lays each process's addressable shards onto the mesh
+    rng = np.random.RandomState(0)
+    xs = rng.rand(world * 8, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (world * 8, 1)).astype(np.int64)
+    losses = []
+    for _ in range(STEPS):
+        (l,) = pe.run(feed={"x": xs, "y": ys}, fetch_list=[avg])
+        losses.append(float(np.asarray(l).reshape(())))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def main():
+    n = int(os.environ.get("SMOKE_TRAINERS", "2"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             str(pid), str(n), coordinator],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(n)
+    ]
+    outs = []
+    ok = True
+    for pid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            print(f"[proc {pid}] TIMEOUT; stderr tail:\n{err[-800:]}")
+            ok = False
+            continue
+        if p.returncode != 0:
+            print(f"[proc {pid}] rc={p.returncode}; stderr tail:\n"
+                  f"{err[-800:]}")
+            ok = False
+            continue
+        line = [l for l in out.splitlines() if l.startswith("LOSSES ")]
+        if not line:
+            print(f"[proc {pid}] no losses printed; stdout:\n{out[-400:]}")
+            ok = False
+            continue
+        outs.append(json.loads(line[-1][len("LOSSES "):]))
+    if not ok or len(outs) != n:
+        print("MULTIHOST SMOKE FAILED")
+        sys.exit(1)
+    import math
+
+    for other in outs[1:]:
+        assert all(
+            math.isfinite(a) and abs(a - b) < 1e-5
+            for a, b in zip(outs[0], other)
+        ), f"processes disagree: {outs}"
+    assert outs[0][-1] < outs[0][0], f"no training progress: {outs[0]}"
+    print(f"MULTIHOST SMOKE OK trainers={n} losses={outs[0]}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    else:
+        main()
