@@ -111,9 +111,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
             op.attrs["is_test"] = True
     scope = scope or global_scope()
     if fold_batch_norm:
+        from .framework.scope import Scope
         from .inference_transpiler import fuse_batch_norm as _fuse
 
-        scope = scope.new_scope()  # folded weights mask the originals
+        # DETACHED overlay (not new_scope(): the parent keeps children
+        # alive, and a job exporting every N steps would accumulate one
+        # set of folded weights per call) — folded values mask the
+        # originals for the save below, then the overlay is garbage
+        scope = Scope(parent=scope)
         _fuse(inference_program, scope)
     os.makedirs(dirname, exist_ok=True)
     meta = {

@@ -106,15 +106,24 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     causal = bool(attrs.get("causal", False))
     sp_mode = str(attrs.get("sp_mode", "ring"))
+    from ..parallel.mesh import axis_size
+
     mesh = getattr(ctx, "mesh", None)
-    if mesh is not None and "sp" in mesh.axis_names and (
-            dict(zip(mesh.axis_names, mesh.devices.shape))["sp"] > 1):
+    if mesh is not None and axis_size(mesh, "sp") > 1:
+        # on TPU the per-shard attention itself runs the Pallas flash
+        # kernel when shapes fit its contract (GSPMD can't partition a
+        # Mosaic call, but inside shard_map each device launches its own)
+        on_tpu = ctx.target_platform() == "tpu"
         if sp_mode == "alltoall":
+            fl = on_tpu and ra.flash_ulysses_eligible(q, mesh, "sp")
             out = ra.ulysses_attention(q, k, v, mesh, axis_name="sp",
-                                       causal=causal)
+                                       causal=causal, use_flash=fl,
+                                       is_train=not ctx.is_test)
         elif sp_mode == "ring":
+            fl = on_tpu and ra.flash_ring_eligible(
+                q, mesh, "sp", causal=causal, is_train=not ctx.is_test)
             out = ra.ring_attention(q, k, v, mesh, axis_name="sp",
-                                    causal=causal)
+                                    causal=causal, use_flash=fl)
         else:
             raise ValueError(
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")

@@ -18,6 +18,12 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 
+def axis_size(mesh, name: str, default: int = 1) -> int:
+    """Size of mesh axis `name` (`default` when the mesh has no such
+    axis) — the one place for the name→size lookup."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, default)
+
+
 def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
     """Build a Mesh. `axes` maps axis name → size; total must divide the
     device count. Default: pure DP over all devices."""

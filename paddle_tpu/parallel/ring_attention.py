@@ -80,10 +80,66 @@ def _ring_body(q, k, v, axis_name: str, causal: bool, scale: float):
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _ring_body_flash(q, k, v, axis_name: str, S: int, scale: float,
+                     interpret: bool):
+    """Non-causal ring loop whose per-chunk attention runs the Pallas
+    flash kernel (VMEM-tiled online softmax — the [t,t] score block never
+    touches HBM).  Each step yields the chunk's normalized output plus its
+    logsumexp; chunks merge exactly via the standard attention-merge
+    identity  o = Σ_s o_s · exp(lse_s − lse_tot),  lse_tot = ⊕ lse_s.
+    Unrolled python loop (S is the static mesh-axis size) so each step is
+    one kernel launch + one ppermute hop."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.pallas_kernels import flash_attention as fa
+
+    o_acc = jnp.zeros(q.shape, jnp.float32)
+    lse_acc = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    for _ in range(S):
+        out_s, lse_s = fa.flash_attention_fwd(
+            q, k_cur, v_cur, causal=False, scale=scale,
+            interpret=interpret)
+        lse_s = lse_s.reshape(lse_acc.shape).astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse_acc, lse_s)
+        o_acc = (o_acc * jnp.exp(lse_acc - lse_new)[..., None]
+                 + out_s.astype(jnp.float32)
+                 * jnp.exp(lse_s - lse_new)[..., None])
+        lse_acc = lse_new
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
+def flash_ring_eligible(q, mesh, axis_name: str, causal: bool,
+                        is_train: bool) -> bool:
+    """Static gate for the flash-kernel ring path: inference-only (the
+    merge needs lse, which the custom_vjp wrapper doesn't expose through
+    the ring), non-causal only (under SPMD every device runs one program,
+    but the causal past/diagonal/future chunk split depends on
+    axis_index — a traced value — so the kernel's static causal flag
+    can't follow it), lane-width head dim, 128-tile chunks."""
+    from ..ops.pallas_kernels._common import kernels_enabled
+
+    from .mesh import axis_size
+
+    if is_train or causal or not kernels_enabled():
+        return False
+    S = axis_size(mesh, axis_name)
+    B, H, T, D = q.shape
+    t = T // S
+    return D <= 128 and t % 128 == 0
+
+
 def ring_attention(q, k, v, mesh, axis_name: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   use_flash: bool = False, interpret: bool = False):
     """q,k,v [B,H,T,D] (T divisible by mesh['sp']) → [B,H,T,D], computed with
-    the sequence axis sharded over `axis_name`."""
+    the sequence axis sharded over `axis_name`.  `use_flash=True` (gate
+    with flash_ring_eligible) runs each per-chunk attention as a Pallas
+    flash kernel and merges chunks by logsumexp."""
     import jax
 
     from .mesh import get_shard_map
@@ -94,22 +150,47 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(None, None, axis_name, None)
+    if use_flash:
+        if causal:
+            raise ValueError(
+                "ring_attention(use_flash=True) does not support causal "
+                "masking (the past/diagonal/future chunk split depends on "
+                "the traced axis_index; see flash_ring_eligible) — call "
+                "with use_flash=False")
+        from .mesh import axis_size
+        body = functools.partial(_ring_body_flash, axis_name=axis_name,
+                                 S=axis_size(mesh, axis_name), scale=s,
+                                 interpret=interpret)
+    else:
+        body = functools.partial(_ring_body, axis_name=axis_name,
+                                 causal=causal, scale=s)
+    kw = {}
+    if use_flash:
+        # pallas_call out_shapes carry no vma annotation; disable the
+        # shard_map replication check for the kernel path
+        kw["check_vma"] = False
     fn = shard_map(
-        functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                          scale=s),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kw,
     )
     return fn(q, k, v)
 
 
-def _ulysses_body(q, k, v, axis_name: str, causal: bool, scale):
+def _ulysses_body(q, k, v, axis_name: str, causal: bool, scale,
+                  use_flash: bool = False, is_train: bool = False,
+                  interpret: bool = False):
     """Per-shard Ulysses step: inputs arrive seq-sharded [B, H, t, D];
     all_to_all re-shards to head-sharded [B, H/S, T, D], attention runs
     dense over the FULL sequence locally, and a second all_to_all restores
     seq sharding.  One collective pair per layer (vs the ring's S hops) —
-    the better trade when H >= S and T/S chunks are small."""
+    the better trade when H >= S and T/S chunks are small.
+
+    Because the local attention is FULL attention over the whole sequence,
+    the Pallas flash kernel drops in unchanged — including the training
+    custom_vjp pair (no cross-chunk merge to thread lse through)."""
     from jax import lax
 
     # [B, H, t, D] --split heads/concat seq--> [B, H/S, S*t, D]
@@ -119,27 +200,56 @@ def _ulysses_body(q, k, v, axis_name: str, causal: bool, scale):
                         tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)
-    oh = attention(qh, kh, vh, causal=causal, scale=scale)
+    if use_flash:
+        from ..ops.pallas_kernels import flash_attention as fa
+
+        if is_train:
+            oh = fa.make_flash_train(causal=causal, scale=scale,
+                                     interpret=interpret)(qh, kh, vh)
+        else:
+            oh = fa.flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                    interpret=interpret)
+    else:
+        oh = attention(qh, kh, vh, causal=causal, scale=scale)
     # back: split seq, concat heads
     return lax.all_to_all(oh, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
 
 
+def flash_ulysses_eligible(q, mesh, axis_name: str) -> bool:
+    """Static gate for flash-kernel Ulysses: after the head re-shard the
+    local problem is full [B, H/S, T, D] attention, so the kernel's
+    contract is just T % 128 == 0 and lane-width D (training included)."""
+    from ..ops.pallas_kernels._common import kernels_enabled
+
+    from .mesh import axis_size
+
+    if not kernels_enabled():
+        return False
+    B, H, T, D = q.shape
+    return H % axis_size(mesh, axis_name) == 0 and T % 128 == 0 and D <= 128
+
+
 def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
-                      causal: bool = False, scale: Optional[float] = None):
+                      causal: bool = False, scale: Optional[float] = None,
+                      use_flash: bool = False, is_train: bool = False,
+                      interpret: bool = False):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism:
     q,k,v [B,H,T,D] with T divisible by mesh[axis_name] and H divisible by
     mesh[axis_name] → [B,H,T,D].  Numerically identical to dense attention
-    (it IS dense attention, re-sharded head-wise)."""
+    (it IS dense attention, re-sharded head-wise).  `use_flash=True` (gate
+    with flash_ulysses_eligible) runs the local attention as the Pallas
+    flash kernel — the training custom_vjp pair when `is_train`."""
     import functools
 
     from jax.sharding import PartitionSpec as P
 
     from .mesh import get_shard_map
 
+    from .mesh import axis_size
+
     shard_map = get_shard_map()
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    S = sizes[axis_name]
+    S = axis_size(mesh, axis_name)
     if q.shape[1] % S:
         raise ValueError(
             f"ulysses attention: head count {q.shape[1]} must be a "
@@ -149,11 +259,14 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
             f"ulysses attention: sequence length {q.shape[2]} must be a "
             f"multiple of the {axis_name!r} axis size {S}")
     spec = P(None, None, axis_name, None)
+    kw = {"check_vma": False} if use_flash else {}
     fn = shard_map(
         functools.partial(_ulysses_body, axis_name=axis_name, causal=causal,
-                          scale=scale),
+                          scale=scale, use_flash=use_flash,
+                          is_train=is_train, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kw,
     )
     return fn(q, k, v)
