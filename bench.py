@@ -83,15 +83,36 @@ def _mfu(flops_per_step, dt):
     return round(100.0 * flops_per_step / dt / peak, 1)
 
 
+def _last_stage(stderr) -> str:
+    """Latest [bench-stage] marker in a (possibly bytes, possibly partial)
+    stderr capture — the where-did-it-hang attribution for timeouts."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode(errors="replace")
+    stages = [l for l in (stderr or "").splitlines()
+              if l.startswith("[bench-stage]")]
+    return (stages[-1].split("] ", 1)[-1] if stages
+            else "none (hung before device init)")
+
+
+def _mark(stage: str):
+    """Progress marker on stderr: when a child dies to a timeout, the
+    parent reports the LAST stage reached, separating tunnel/backend
+    hangs from compile time from measurement (evidence attribution)."""
+    print(f"[bench-stage] {stage}", file=sys.stderr, flush=True)
+
+
 def _timed_loop(exe, feed, fetch, warmup, iters):
     import jax
 
+    _mark("compile+warmup")
     for _ in range(warmup):
         (out,) = exe.run(feed=feed, fetch_list=[fetch])
+    _mark("timing")
     t0 = time.perf_counter()
     for _ in range(iters):
         (out,) = exe.run(feed=feed, fetch_list=[fetch], return_numpy=False)
     jax.block_until_ready(out)
+    _mark("timing done")
     return (time.perf_counter() - t0) / iters
 
 
@@ -101,7 +122,9 @@ def _stage(place, arrays):
     import jax
 
     dev = place.jax_device()
-    return {k: jax.device_put(v, dev) for k, v in arrays.items()}
+    out = {k: jax.device_put(v, dev) for k, v in arrays.items()}
+    _mark("device ready, batch staged")
+    return out
 
 
 def bench_resnet_train(warmup, iters, layout=None):
@@ -430,9 +453,10 @@ def main():
                         out = run_child(
                             name, {"PADDLE_TPU_NO_FUSED_KERNELS": "1"},
                             min(mode_cap, remaining))
-                    except subprocess.TimeoutExpired:
+                    except subprocess.TimeoutExpired as rte:
                         raise RuntimeError(
-                            f"Mosaic failure; fallback retry timed out. "
+                            f"Mosaic failure; fallback retry timed out at "
+                            f"stage: {_last_stage(rte.stderr)}. "
                             f"First attempt: {err_text[-300:]}")
                     lines = [l for l in out.stdout.strip().splitlines()
                              if l.startswith("{")]
@@ -447,13 +471,13 @@ def main():
                 else:
                     raise RuntimeError(
                         f"mode subprocess rc={out.returncode}: {err_text}")
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as te:
             results[name] = {
                 "metric": name, "value": 0.0, "unit": "error",
                 "vs_baseline": 0.0,
-                "error": f"timeout after {min(mode_cap, remaining):.0f}s "
-                         f"(not a kernel failure; likely compile or "
-                         f"tunnel latency)"}
+                "error": f"timeout after {min(mode_cap, remaining):.0f}s; "
+                         f"last stage reached: {_last_stage(te.stderr)} "
+                         f"(not a kernel failure)"}
         except Exception as e:  # one broken mode must not hide the others;
             # keep the documented key set so parsers see a recognizable zero
             results[name] = {"metric": name, "value": 0.0, "unit": "error",

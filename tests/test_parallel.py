@@ -475,3 +475,28 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
                                     fetch_list=[avg])[0]).reshape(-1)[0])
            for _ in range(3)]
     np.testing.assert_allclose(got, expect, rtol=2e-4, atol=1e-5)
+
+
+def test_remat_composes_with_parallel_executor():
+    """layers.recompute segments (the bench remat default) must lower and
+    train under a dp-sharded mesh — the recompute op's sub-block traces
+    inside the pjit program."""
+    from paddle_tpu.models import resnet
+
+    def losses(remat):
+        fluid.reset()
+        avg_cost, _ = resnet.build_train_program(
+            batch_size=8, depth=18, class_dim=10, image_shape=(3, 32, 32),
+            dtype="float32", layout="NCHW", remat=remat)
+        pe = ParallelExecutor(axes={"dp": 8})
+        pe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {"image": rng.rand(8, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+        return [float(np.asarray(pe.run(feed=feed,
+                                        fetch_list=[avg_cost])[0]).item())
+                for _ in range(3)]
+
+    plain = losses(False)
+    remat = losses(True)
+    np.testing.assert_allclose(remat, plain, rtol=1e-3)
