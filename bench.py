@@ -56,6 +56,15 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12, "TPU v6 lite": 918e12,
 }
 
+def _env_layout(default="NHWC") -> str:
+    """Normalized/validated BENCH_LAYOUT: a typo must fail loudly, not
+    silently run NCHW compute under an NHWC-labeled metric."""
+    v = os.environ.get("BENCH_LAYOUT", default).upper()
+    if v not in ("NHWC", "NCHW"):
+        raise ValueError(f"BENCH_LAYOUT={v!r}: use NHWC or NCHW")
+    return v
+
+
 def _mosaic_signatures():
     """Stderr signatures that implicate the fused Pallas kernels — the
     shared classifier (paddle_tpu.ops.pallas_kernels._common, also used by
@@ -144,7 +153,7 @@ def bench_resnet_train(warmup, iters, layout=None):
     # 4.5x compute headroom) — BENCH_REMAT=0 opts out
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
     if layout is None:
-        layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+        layout = _env_layout()
 
     avg_cost, acc = resnet.build_train_program(
         batch_size=bs, depth=depth, dtype=dtype, layout=layout, remat=remat)
@@ -208,7 +217,7 @@ def bench_resnet_infer(warmup, iters):
     bs = int(os.environ.get("BENCH_INFER_BS", "16"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+    layout = _env_layout()
 
     shape = [224, 224, 3] if layout == "NHWC" else [3, 224, 224]
     img = layers.data(name="image", shape=shape, dtype=dtype)
@@ -258,15 +267,18 @@ def bench_cnn_train(model_name, warmup, iters):
     base = {"alexnet": 498.94, "googlenet": 264.83, "vgg": 29.83}[model_name]
     bs = int(os.environ.get("BENCH_BS", "128"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    layout = _env_layout()  # TPU-preferred channels-last default
 
-    img = layers.data(name="image", shape=[3, 224, 224], dtype=dtype)
+    shape = [224, 224, 3] if layout == "NHWC" else [3, 224, 224]
+    img = layers.data(name="image", shape=shape, dtype=dtype)
     label = layers.data(name="label", shape=[1], dtype="int64")
     if model_name == "alexnet":
-        logits = image_models.alexnet(img, class_dim=1000)
+        logits = image_models.alexnet(img, class_dim=1000, layout=layout)
     elif model_name == "googlenet":
-        logits = image_models.googlenet(img, class_dim=1000)
+        logits = image_models.googlenet(img, class_dim=1000, layout=layout)
     else:
-        logits = vgg.vgg19(img, class_dim=1000)  # the VGG-19 anchor's model
+        logits = vgg.vgg19(img, class_dim=1000,
+                           layout=layout)  # the VGG-19 anchor's model
     logits32 = layers.cast(logits, "float32") if dtype != "float32" else logits
     loss = layers.mean(layers.softmax_with_cross_entropy(logits32, label))
     fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
@@ -276,7 +288,7 @@ def bench_cnn_train(model_name, warmup, iters):
     exe.run(fluid.default_startup_program())
     rng = np.random.RandomState(0)
     feed = _stage(place, {
-        "image": jnp.asarray(rng.rand(bs, 3, 224, 224).astype(np.float32),
+        "image": jnp.asarray(rng.rand(bs, *shape).astype(np.float32),
                              dtype=np_dtype(dtype)),
         "label": jnp.asarray(rng.randint(0, 1000, (bs, 1)).astype(np.int64)),
     })
@@ -284,7 +296,7 @@ def bench_cnn_train(model_name, warmup, iters):
     img_s = bs / dt
     name = "vgg19" if model_name == "vgg" else model_name
     return {
-        "metric": f"{name}_train_img_per_s_{dtype}_bs{bs}",
+        "metric": f"{name}_train_img_per_s_{dtype}_bs{bs}_{layout.lower()}",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / base, 2),
@@ -343,6 +355,8 @@ def bench_lstm_train(warmup, iters):
 
 
 def main():
+    _env_layout()  # fail fast on a bad BENCH_LAYOUT, before backend init
+
     import paddle_tpu as fluid
 
     model = os.environ.get("BENCH_CHILD_MODE") \

@@ -6,21 +6,25 @@ from __future__ import annotations
 from .. import layers
 
 
-def alexnet(input, class_dim=1000):
-    """benchmark/paddle/image/alexnet.py topology (227x227 NCHW)."""
+def alexnet(input, class_dim=1000, layout="NCHW"):
+    """benchmark/paddle/image/alexnet.py topology (227x227; NCHW is the
+    reference contract, NHWC the TPU-preferred channels-last path)."""
     c1 = layers.conv2d(input, num_filters=64, filter_size=11, stride=4,
-                       padding=2, act="relu")
-    p1 = layers.pool2d(c1, pool_size=3, pool_stride=2, pool_type="max")
+                       padding=2, act="relu", data_format=layout)
+    p1 = layers.pool2d(c1, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
     c2 = layers.conv2d(p1, num_filters=192, filter_size=5, padding=2,
-                       act="relu")
-    p2 = layers.pool2d(c2, pool_size=3, pool_stride=2, pool_type="max")
+                       act="relu", data_format=layout)
+    p2 = layers.pool2d(c2, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
     c3 = layers.conv2d(p2, num_filters=384, filter_size=3, padding=1,
-                       act="relu")
+                       act="relu", data_format=layout)
     c4 = layers.conv2d(c3, num_filters=256, filter_size=3, padding=1,
-                       act="relu")
+                       act="relu", data_format=layout)
     c5 = layers.conv2d(c4, num_filters=256, filter_size=3, padding=1,
-                       act="relu")
-    p5 = layers.pool2d(c5, pool_size=3, pool_stride=2, pool_type="max")
+                       act="relu", data_format=layout)
+    p5 = layers.pool2d(c5, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
     d1 = layers.dropout(p5, 0.5)
     f1 = layers.fc(input=d1, size=4096, act="relu")
     d2 = layers.dropout(f1, 0.5)
@@ -28,43 +32,53 @@ def alexnet(input, class_dim=1000):
     return layers.fc(input=f2, size=class_dim)
 
 
-def _inception(x, nf1, nf3r, nf3, nf5r, nf5, proj):
-    b1 = layers.conv2d(x, num_filters=nf1, filter_size=1, act="relu")
-    b3 = layers.conv2d(x, num_filters=nf3r, filter_size=1, act="relu")
+def _inception(x, nf1, nf3r, nf3, nf5r, nf5, proj, layout="NCHW"):
+    ch_axis = 3 if layout == "NHWC" else 1
+    b1 = layers.conv2d(x, num_filters=nf1, filter_size=1, act="relu",
+                       data_format=layout)
+    b3 = layers.conv2d(x, num_filters=nf3r, filter_size=1, act="relu",
+                       data_format=layout)
     b3 = layers.conv2d(b3, num_filters=nf3, filter_size=3, padding=1,
-                       act="relu")
-    b5 = layers.conv2d(x, num_filters=nf5r, filter_size=1, act="relu")
+                       act="relu", data_format=layout)
+    b5 = layers.conv2d(x, num_filters=nf5r, filter_size=1, act="relu",
+                       data_format=layout)
     b5 = layers.conv2d(b5, num_filters=nf5, filter_size=5, padding=2,
-                       act="relu")
+                       act="relu", data_format=layout)
     bp = layers.pool2d(x, pool_size=3, pool_stride=1, pool_padding=1,
-                       pool_type="max")
-    bp = layers.conv2d(bp, num_filters=proj, filter_size=1, act="relu")
-    return layers.concat([b1, b3, b5, bp], axis=1)
+                       pool_type="max", data_format=layout)
+    bp = layers.conv2d(bp, num_filters=proj, filter_size=1, act="relu",
+                       data_format=layout)
+    return layers.concat([b1, b3, b5, bp], axis=ch_axis)
 
 
-def googlenet(input, class_dim=1000):
+def googlenet(input, class_dim=1000, layout="NCHW"):
     """benchmark/paddle/image/googlenet.py (main tower, no aux heads —
     the benchmark runs throughput, aux heads are train-time extras)."""
     c1 = layers.conv2d(input, num_filters=64, filter_size=7, stride=2,
-                       padding=3, act="relu")
-    p1 = layers.pool2d(c1, pool_size=3, pool_stride=2, pool_type="max")
-    c2 = layers.conv2d(p1, num_filters=64, filter_size=1, act="relu")
+                       padding=3, act="relu", data_format=layout)
+    p1 = layers.pool2d(c1, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
+    c2 = layers.conv2d(p1, num_filters=64, filter_size=1, act="relu",
+                       data_format=layout)
     c3 = layers.conv2d(c2, num_filters=192, filter_size=3, padding=1,
-                       act="relu")
-    p3 = layers.pool2d(c3, pool_size=3, pool_stride=2, pool_type="max")
-    i3a = _inception(p3, 64, 96, 128, 16, 32, 32)
-    i3b = _inception(i3a, 128, 128, 192, 32, 96, 64)
-    p4 = layers.pool2d(i3b, pool_size=3, pool_stride=2, pool_type="max")
-    i4a = _inception(p4, 192, 96, 208, 16, 48, 64)
-    i4b = _inception(i4a, 160, 112, 224, 24, 64, 64)
-    i4c = _inception(i4b, 128, 128, 256, 24, 64, 64)
-    i4d = _inception(i4c, 112, 144, 288, 32, 64, 64)
-    i4e = _inception(i4d, 256, 160, 320, 32, 128, 128)
-    p5 = layers.pool2d(i4e, pool_size=3, pool_stride=2, pool_type="max")
-    i5a = _inception(p5, 256, 160, 320, 32, 128, 128)
-    i5b = _inception(i5a, 384, 192, 384, 48, 128, 128)
+                       act="relu", data_format=layout)
+    p3 = layers.pool2d(c3, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
+    i3a = _inception(p3, 64, 96, 128, 16, 32, 32, layout)
+    i3b = _inception(i3a, 128, 128, 192, 32, 96, 64, layout)
+    p4 = layers.pool2d(i3b, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
+    i4a = _inception(p4, 192, 96, 208, 16, 48, 64, layout)
+    i4b = _inception(i4a, 160, 112, 224, 24, 64, 64, layout)
+    i4c = _inception(i4b, 128, 128, 256, 24, 64, 64, layout)
+    i4d = _inception(i4c, 112, 144, 288, 32, 64, 64, layout)
+    i4e = _inception(i4d, 256, 160, 320, 32, 128, 128, layout)
+    p5 = layers.pool2d(i4e, pool_size=3, pool_stride=2, pool_type="max",
+                       data_format=layout)
+    i5a = _inception(p5, 256, 160, 320, 32, 128, 128, layout)
+    i5b = _inception(i5a, 384, 192, 384, 48, 128, 128, layout)
     gp = layers.pool2d(i5b, pool_size=7, pool_type="avg",
-                       global_pooling=True)
+                       global_pooling=True, data_format=layout)
     d = layers.dropout(gp, 0.4)
     return layers.fc(input=d, size=class_dim)
 

@@ -6,14 +6,16 @@ from __future__ import annotations
 from .. import layers, nets
 
 
-def vgg16(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096):
+def vgg16(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096,
+          layout="NCHW"):
     """Full VGG-16 (conv batches 2-2-3-3-3 + two fc4096)."""
 
     def group(x, nf, n):
         return nets.img_conv_group(
             x, conv_num_filter=[nf] * n, conv_filter_size=3,
             conv_act="relu", conv_with_batchnorm=True,
-            conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2)
+            conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2,
+            data_format=layout)
 
     c1 = group(input, 64, 2)
     c2 = group(c1, 128, 2)
@@ -28,7 +30,8 @@ def vgg16(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096):
     return layers.fc(input=f2, size=class_dim)
 
 
-def vgg19(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096):
+def vgg19(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096,
+          layout="NCHW"):
     """VGG-19 (conv batches 2-2-4-4-4) — the BASELINE.md benchmark variant
     (IntelOptimizedPaddle.md VGG-19 rows)."""
 
@@ -36,7 +39,8 @@ def vgg19(input, class_dim=1000, dropout_prob=0.5, fc_dim=4096):
         return nets.img_conv_group(
             x, conv_num_filter=[nf] * n, conv_filter_size=3,
             conv_act="relu", conv_with_batchnorm=True,
-            conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2)
+            conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2,
+            data_format=layout)
 
     c1 = group(input, 64, 2)
     c2 = group(c1, 128, 2)

@@ -19,7 +19,7 @@ def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
 def img_conv_group(input, conv_num_filter, conv_filter_size=3,
                    conv_act="relu", conv_with_batchnorm=False,
                    conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2,
-                   pool_type="max", param_attr=None):
+                   pool_type="max", param_attr=None, data_format="NCHW"):
     tmp = input
     if not isinstance(conv_with_batchnorm, (list, tuple)):
         conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
@@ -31,14 +31,16 @@ def img_conv_group(input, conv_num_filter, conv_filter_size=3,
         tmp = layers.conv2d(
             input=tmp, num_filters=nf, filter_size=conv_filter_size,
             padding=(conv_filter_size - 1) // 2, param_attr=param_attr,
-            act=local_act)
+            act=local_act, data_format=data_format)
         if conv_with_batchnorm[i]:
-            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            tmp = layers.batch_norm(input=tmp, act=conv_act,
+                                    data_layout=data_format)
             if conv_batchnorm_drop_rate[i] > 0:
                 tmp = layers.dropout(x=tmp,
                                      dropout_prob=conv_batchnorm_drop_rate[i])
     return layers.pool2d(input=tmp, pool_size=pool_size,
-                         pool_stride=pool_stride, pool_type=pool_type)
+                         pool_stride=pool_stride, pool_type=pool_type,
+                         data_format=data_format)
 
 
 def glu(input, dim=-1):

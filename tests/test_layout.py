@@ -87,3 +87,45 @@ def test_resnet_cifar_trains_nhwc():
               for _ in range(8)]
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
+
+
+def _model_logits(model, layout, x_nchw):
+    """Build `model` in `layout`, run on the transposed feed, return
+    logits.  Same program random_seed + same param names => identical
+    weights across the two builds (filters are OIHW in both layouts)."""
+    from paddle_tpu.models import image_models, vgg
+
+    fluid.reset()
+    C, H, W = x_nchw.shape[1:]
+    shape = [C, H, W] if layout == "NCHW" else [H, W, C]
+    img = layers.data(name="x", shape=shape, dtype="float32")
+    if model == "alexnet":
+        out = image_models.alexnet(img, class_dim=10, layout=layout)
+    elif model == "googlenet":
+        out = image_models.googlenet(img, class_dim=10, layout=layout)
+    else:
+        out = vgg.vgg16(img, class_dim=10, dropout_prob=0.0, fc_dim=64,
+                        layout=layout)
+    feed = x_nchw if layout == "NCHW" else np.transpose(x_nchw,
+                                                        (0, 2, 3, 1))
+    return np.asarray(_run({"x": feed}, out))
+
+
+def test_bench_cnn_models_nhwc_match_nchw():
+    """The opt-in bench CNNs (alexnet, googlenet incl. inception concat
+    axis, vgg16 via img_conv_group) produce the same logits in NHWC as
+    NCHW.
+
+    LOAD-BEARING input sizes: exact equality requires the pre-fc feature
+    map to be 1x1 spatial (hw=64 for alexnet/googlenet, 32 for vgg) — fc
+    flattens C,H,W in NCHW but H,W,C in NHWC, so at larger sizes the two
+    layouts are only weight-permutation-equivalent, not elementwise
+    equal."""
+    rng = np.random.RandomState(0)
+    for model, hw in (("alexnet", 64), ("googlenet", 64), ("vgg", 32)):
+        x = rng.rand(2, 3, hw, hw).astype(np.float32)
+        a = _model_logits(model, "NCHW", x)
+        b = _model_logits(model, "NHWC", x)
+        np.testing.assert_allclose(
+            b, a, atol=5e-4, rtol=5e-4,
+            err_msg=f"{model} NHWC diverges from NCHW")
