@@ -44,6 +44,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+from tools.probe_common import json_lines, pause_file, probe_once
+
 RESNET_TRAIN_BASE = 81.69   # img/s  (IntelOptimizedPaddle.md:45)
 RESNET_INFER_BASE = 217.69  # img/s  (IntelOptimizedPaddle.md:87, bs16)
 LSTM_TRAIN_BASE_MS = 184.0  # ms/batch (benchmark/README.md:119)
@@ -420,6 +422,8 @@ def main():
         extras = [results[n] for n in modes[1:] if n in results]
         if extras:
             headline["extra_metrics"] = extras
+        if probe_attempts:
+            headline["preflight_probes"] = probe_attempts
         print(json.dumps(headline), flush=True)
 
     def run_child(name, extra, timeout):
@@ -427,6 +431,75 @@ def main():
             [sys.executable, os.path.abspath(__file__)],
             env={**os.environ, "BENCH_CHILD_MODE": name, **extra},
             capture_output=True, text=True, timeout=timeout)
+
+    # Pre-flight probe (VERDICT r3 Weak #1): a wedged tunnel used to burn
+    # 420s+120s serially before producing its first "timeout" line.  A
+    # ~45s `jax.devices()` subprocess diagnoses the same condition for a
+    # tenth of the budget; on failure we RETRY the probe on a backoff loop
+    # for the remaining budget (the tunnel is known to wedge transiently)
+    # and record every attempt with timestamps so an all-timeout round
+    # still leaves evidence the tunnel never came up.  BENCH_NO_PREFLIGHT=1
+    # opts out.
+    probe_attempts = []
+    if not os.environ.get("BENCH_NO_PREFLIGHT"):
+        # Stand the evidence daemon down for the duration of this run: its
+        # captures hold the single-client TPU, which would make OUR probes
+        # time out and record false tunnel-down evidence.  The daemon
+        # polls this file mid-capture and kills its in-flight child; it
+        # also treats a pause older than 2h as stale, so a killed bench
+        # run can't pause it forever.
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        pause_path = pause_file(repo_root)
+        try:
+            with open(pause_path, "w") as f:
+                f.write(f"bench.py pid={os.getpid()} "
+                        f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+            import atexit
+
+            atexit.register(lambda: os.path.exists(pause_path)
+                            and os.remove(pause_path))
+            # grace window: the daemon polls the pause file every ~10s and
+            # needs a moment to kill an in-flight capture; probing sooner
+            # could record a false tunnel-down attempt.  Only worth paying
+            # when a daemon has recently been alive (probe-log heartbeat).
+            heartbeat = os.path.join(os.path.dirname(pause_path),
+                                     "probe_log.jsonl")
+            try:
+                if time.time() - os.path.getmtime(heartbeat) < 2400:
+                    time.sleep(12)
+            except OSError:
+                pass
+        except OSError:
+            pass
+
+        tunnel_up = False
+        while budget - (time.monotonic() - t_start) >= 65:
+            remaining = budget - (time.monotonic() - t_start)
+            att = probe_once(min(45.0, remaining), env=dict(os.environ))
+            att["t_offset_s"] = round(time.monotonic() - t_start, 1)
+            probe_attempts.append(att)
+            if att["ok"]:
+                tunnel_up = True
+                break
+            # only a HANG suggests the transiently-wedged tunnel; a fast
+            # rc!=0 is deterministic (broken install, bad JAX_PLATFORMS)
+            # and retrying it would eat the whole budget for nothing
+            if not att["timed_out"]:
+                break
+            time.sleep(min(20.0, max(0.0, budget - (time.monotonic() - t_start) - 65)))
+        # zero attempts = budget too small to probe at all: fall through and
+        # let the per-mode budget checks do their (already-tested) thing
+        # rather than claiming a tunnel verdict we never tested
+        if not tunnel_up and probe_attempts:
+            print(json.dumps({
+                "metric": "resnet", "value": 0.0, "unit": "error",
+                "vs_baseline": 0.0,
+                "error": f"backend never initialized: {len(probe_attempts)} "
+                         f"pre-flight probe(s) failed over "
+                         f"{time.monotonic()-t_start:.0f}s of "
+                         f"BENCH_BUDGET={budget:.0f}s",
+                "preflight_probes": probe_attempts}), flush=True)
+            return
 
     for name in modes:
         # each mode runs in its own PROCESS: co-resident executables and
@@ -444,10 +517,9 @@ def main():
             continue
         try:
             out = run_child(name, {}, min(mode_cap, remaining))
-            lines = [l for l in out.stdout.strip().splitlines()
-                     if l.startswith("{")]
+            lines = json_lines(out.stdout)
             if lines:
-                results[name] = json.loads(lines[-1])
+                results[name] = lines[-1]
             else:
                 err_text = out.stderr.strip()[-600:]
                 # retry with fused kernels off ONLY when the failure
@@ -472,13 +544,12 @@ def main():
                             f"Mosaic failure; fallback retry timed out at "
                             f"stage: {_last_stage(rte.stderr)}. "
                             f"First attempt: {err_text[-300:]}")
-                    lines = [l for l in out.stdout.strip().splitlines()
-                             if l.startswith("{")]
+                    lines = json_lines(out.stdout)
                     if not lines:
                         raise RuntimeError(
                             f"fused retry also failed rc={out.returncode}: "
                             f"{out.stderr.strip()[-300:]}")
-                    results[name] = json.loads(lines[-1])
+                    results[name] = lines[-1]
                     results[name]["note"] = (
                         "fused kernels disabled after Mosaic failure; "
                         f"first attempt: {err_text[-300:]}")

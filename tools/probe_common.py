@@ -1,0 +1,76 @@
+"""Shared TPU-tunnel probe: one source of truth for bench.py and
+tools/evidence_daemon.py (code review r4: the jax.config-mirroring snippet
+is load-bearing and must not fork).
+
+The probe runs `jax.devices()` in a subprocess with a hard timeout.  An
+explicit JAX_PLATFORMS env var is mirrored into jax.config first —
+paddle_tpu.__init__'s trick — because the axon plugin pins its platform via
+jax.config at import, which would otherwise beat the env var and hang a
+CPU-selected probe on a wedged tunnel.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+# One source of truth for the daemon<->bench handshake locations: a rename
+# applied to only one side would silently break the stand-down protocol.
+EVIDENCE_DIR_DEFAULT = "BENCH_attempts_r04"
+
+
+def evidence_dir(repo_root):
+    return os.path.join(repo_root,
+                        os.environ.get("EVIDENCE_DIR", EVIDENCE_DIR_DEFAULT))
+
+
+def pause_file(repo_root):
+    return os.path.join(evidence_dir(repo_root), "daemon.pause")
+
+
+PROBE_SRC = ("import os, jax\n"
+             "p = os.environ.get('JAX_PLATFORMS')\n"
+             "p and jax.config.update('jax_platforms', p)\n"
+             "d = jax.devices()[0]\n"
+             "print('PROBE_OK', d.platform, d.device_kind)\n")
+
+
+def json_lines(text):
+    """The complete JSON-object lines in possibly-truncated output — a
+    child killed mid-print leaves a partial line that must not turn into a
+    crash (daemon) or a mislabeled failure (bench parent)."""
+    import json
+
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    out = []
+    for l in (text or "").strip().splitlines():
+        if l.startswith("{"):
+            try:
+                out.append(json.loads(l))
+            except ValueError:
+                pass
+    return out
+
+
+def probe_once(timeout, env=None):
+    """One probe attempt -> record dict.
+
+    Keys: ok (bool), detail (str), elapsed_s, utc, and timed_out (True only
+    for a hang — a fast rc!=0 failure is deterministic, e.g. a broken
+    plugin install, and callers should NOT retry it on a backoff loop).
+    """
+    t0 = time.monotonic()
+    rec = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "timed_out": False}
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE_SRC], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+        rec["ok"] = "PROBE_OK" in p.stdout
+        rec["detail"] = (p.stdout.strip()[:200] if rec["ok"]
+                         else (p.stderr.strip()[-300:] or f"rc={p.returncode}"))
+    except subprocess.TimeoutExpired:
+        rec.update(ok=False, timed_out=True,
+                   detail=f"probe timeout after {timeout:.0f}s")
+    rec["elapsed_s"] = round(time.monotonic() - t0, 1)
+    return rec
