@@ -154,11 +154,15 @@ def bench_resnet_train(warmup, iters, layout=None):
     # the 53.8 GB/step is stored fusion writes; the step is HBM-bound with
     # 4.5x compute headroom) — BENCH_REMAT=0 opts out
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    # BN->1x1-conv prologue fusion (training_fusion.py): opt-in until the
+    # on-chip A/B (evidence daemon ab_resnet_bnfuse) decides the default
+    fuse_bn = os.environ.get("BENCH_FUSE_BN", "0") == "1"
     if layout is None:
         layout = _env_layout()
 
     avg_cost, acc = resnet.build_train_program(
-        batch_size=bs, depth=depth, dtype=dtype, layout=layout, remat=remat)
+        batch_size=bs, depth=depth, dtype=dtype, layout=layout, remat=remat,
+        fuse_bn=fuse_bn and layout == "NHWC")
     place = fluid.default_place()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
@@ -174,7 +178,8 @@ def bench_resnet_train(warmup, iters, layout=None):
     img_s = bs / dt
     out = {
         "metric": f"resnet{depth}_train_img_per_s_{dtype}_bs{bs}_"
-                  f"{layout.lower()}{'_remat' if remat else ''}",
+                  f"{layout.lower()}{'_remat' if remat else ''}"
+                  f"{'_bnfuse' if fuse_bn and layout == 'NHWC' else ''}",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / RESNET_TRAIN_BASE, 2),

@@ -124,7 +124,7 @@ def resnet_cifar10(input, class_dim=10, depth=32, layout="NCHW"):
 def build_train_program(batch_size=64, depth=50, class_dim=1000,
                         image_shape=(3, 224, 224), dtype="float32",
                         learning_rate=0.1, momentum=0.9, layout="NCHW",
-                        remat=False):
+                        remat=False, fuse_bn=None):
     """Full training program: returns (avg_cost, accuracy).
 
     With dtype='bfloat16' the conv/GEMM path runs natively on the MXU; the
@@ -149,6 +149,17 @@ def build_train_program(batch_size=64, depth=50, class_dim=1000,
     avg_cost = layers.mean(loss)
     prob = layers.softmax(logits32)
     acc = layers.accuracy(input=prob, label=label)
+    # BN(+residual)+ReLU -> 1x1-conv prologue fusion (training_fusion.py):
+    # must run before minimize so backward differentiates the fused graph.
+    # NHWC-only; default comes from env until the on-chip A/B decides it.
+    import os
+
+    if fuse_bn is None:
+        fuse_bn = os.environ.get("PADDLE_TPU_FUSE_BN_MM") == "1"
+    if fuse_bn and layout == "NHWC":
+        from ..training_fusion import fuse_bn_matmul
+
+        fuse_bn_matmul(fluid.default_main_program())
     opt = fluid.optimizer.Momentum(learning_rate=learning_rate,
                                    momentum=momentum)
     opt.minimize(avg_cost)

@@ -188,9 +188,12 @@ def pool2d(ctx, ins, attrs):
     return {"Out": [_pool_nd(ins["X"][0], attrs, 2)]}
 
 
-@register_op("batch_norm", non_diff_outputs=("MeanOut", "VarianceOut",
-                                             "SavedMean", "SavedVariance"))
+@register_op("batch_norm", non_diff_outputs=("MeanOut", "VarianceOut"))
 def batch_norm(ctx, ins, attrs):
+    # SavedMean/SavedVariance are DIFFABLE (they're pure functions of X in
+    # train mode): training_fusion routes the fused 1x1-conv's dmean/dvar
+    # cotangents through them back into dX.  Ordinary programs leave the
+    # saved vars stop_gradient, so nothing changes for them.
     """Reference batch_norm_op.cc. Train mode: batch stats + running-stat
     update (MeanOut/VarianceOut alias the Mean/Variance state vars, persisted
     by the executor's written-state logic). Test mode: running stats."""
@@ -208,21 +211,24 @@ def batch_norm(ctx, ins, attrs):
     axes = tuple(i for i in range(x.ndim) if i != ch)
     shape = [1] * x.ndim
     shape[ch] = x.shape[ch]
+    # stats dtype: f32 for stability under bf16/f16, but f64 inputs keep
+    # f64 (a hard f32 cast would silently truncate double-precision runs)
+    sdt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
 
     if is_test:
         use_mean, use_var = mean, var
         mean_out, var_out = mean, var
         saved_mean, saved_var = mean, var
     else:
-        f32 = x.astype(jnp.float32)
-        use_mean = jnp.mean(f32, axis=axes)
-        use_var = jnp.var(f32, axis=axes)
+        xs = x.astype(sdt)
+        use_mean = jnp.mean(xs, axis=axes)
+        use_var = jnp.var(xs, axis=axes)
         mean_out = momentum * mean + (1 - momentum) * use_mean.astype(mean.dtype)
         var_out = momentum * var + (1 - momentum) * use_var.astype(var.dtype)
         saved_mean, saved_var = use_mean, use_var
 
-    inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
-    xhat = (x.astype(jnp.float32) - use_mean.reshape(shape)) * inv.reshape(shape)
+    inv = 1.0 / jnp.sqrt(use_var.astype(sdt) + eps)
+    xhat = (x.astype(sdt) - use_mean.reshape(shape)) * inv.reshape(shape)
     y = (xhat * scale.reshape(shape) + bias.reshape(shape)).astype(x.dtype)
     return {
         "Y": [y],
@@ -231,6 +237,59 @@ def batch_norm(ctx, ins, attrs):
         "SavedMean": [saved_mean],
         "SavedVariance": [saved_var],
     }
+
+
+@register_op("bn_act_conv1x1")
+def bn_act_conv1x1(ctx, ins, attrs):
+    """Fused BatchNorm(+residual)+act -> 1x1 convolution (NHWC): the
+    normalized activation never materializes in HBM — on TPU via the
+    Pallas bn_matmul kernel pair (custom_vjp: single-sweep fused backward
+    with VMEM-resident dW/dgamma/dbeta accumulators), elsewhere via the
+    jnp reference that XLA fuses as well as it can.  Created only by
+    training_fusion.fuse_bn_matmul, which reads the stats from the kept
+    batch_norm op's SavedMean/SavedVariance outputs; replaces what the
+    reference would hand-fuse in paddle/cuda conv epilogues
+    (SURVEY.md §2.10)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]           # [N,H,W,K] raw conv output (pre-BN)
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["SavedMean"][0], ins["SavedVariance"][0]
+    w = ins["Filter"][0]      # OIHW [O, K, 1, 1]
+    res = ins["Residual"][0] if ins.get("Residual") else None
+    eps = float(attrs.get("epsilon", 1e-5))
+    act = attrs.get("act") or None
+    strides = _pair(attrs.get("strides", [1, 1]))
+
+    if strides != [1, 1]:
+        x = x[:, ::strides[0], ::strides[1], :]
+        if res is not None:
+            res = res[:, ::strides[0], ::strides[1], :]
+    n, h, ww, k = x.shape
+    o = w.shape[0]
+    x2 = x.reshape(n * h * ww, k)
+    r2 = res.reshape(n * h * ww, k) if res is not None else None
+    w2 = w.reshape(o, k).T  # [K, O]
+
+    from .pallas_kernels import bn_matmul as bmm
+    from .pallas_kernels._common import kernels_enabled
+
+    out2 = None
+    if (ctx.target_platform() == "tpu" and kernels_enabled()
+            and bmm.eligible(x2.shape[0], k, o, x2.dtype.itemsize,
+                             train=not ctx.is_test)):
+        f = bmm.make_bn_matmul_train(act=act, eps=eps,
+                                     has_residual=r2 is not None)
+        args = (x2, scale.astype(jnp.float32), bias.astype(jnp.float32),
+                mean.astype(jnp.float32), var.astype(jnp.float32), w2)
+        out2 = f(*args, r2) if r2 is not None else f(*args)
+    if out2 is None:
+        sdt = jnp.float64 if x2.dtype == jnp.float64 else jnp.float32
+        out2 = bmm.bn_matmul_reference(
+            x2, scale.astype(sdt), bias.astype(sdt),
+            mean.astype(sdt), var.astype(sdt), w2,
+            r=r2, act=act, eps=eps)
+    return {"Output": [out2.reshape(n, h, ww, o)]}
 
 
 @register_op("layer_norm")

@@ -1,0 +1,306 @@
+"""Fused BatchNorm(+residual)+ReLU -> matmul Pallas kernel: the conv-epilogue
+fusion the ResNet roofline demands (docs/perf_resnet50_roofline.md).
+
+A 1x1 convolution in NHWC is a matmul over [M=N*H*W, K=C_in] @ [K, N=C_out].
+XLA cannot fuse the BatchNorm apply / ReLU / residual-add chains into its
+convolution custom-calls, so every one of those chains materializes a
+full activation tensor in HBM (measured 12.9 GB/step of fusion writes on
+the bs128 train step).  This kernel normalizes the RAW conv output inside
+the matmul's operand load — the normalized activation never exists in HBM:
+
+    Out = act((X - mean) * invstd * gamma + beta [+ R]) @ W
+
+The backward is a single sweep over M with VMEM-resident accumulators
+(cuDNN-style fused dgrad): one pass reads X and dOut once, writes dX
+(and dR), and accumulates dW, dgamma, dbeta on-chip — no dA or A tensor
+ever materializes.  d(mean)/d(var) are derived from dgamma/dbeta outside
+the kernel (closed form), so the desc-level autodiff composes the full
+BatchNorm training gradient through the producing batch_norm op's
+now-differentiable SavedMean/SavedVariance outputs.
+
+Replaces what the reference hand-fused in paddle/cuda (SURVEY.md §2.10);
+the role model is conv_cudnn's fused epilogues, rebuilt TPU-style.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ._common import TRAIN_VMEM_BUDGET
+
+
+def _prologue(x, params, eps, act, r=None):
+    """f32 normalize(+residual)+act of an [bm, K] tile; params [4,K] f32
+    rows = gamma, beta, mean, var."""
+    import jax
+    import jax.numpy as jnp
+
+    g, b, mu, var = (params[i] for i in range(4))
+    inv = jax.lax.rsqrt(var + eps)
+    pre = (x.astype(jnp.float32) - mu) * (inv * g) + b
+    if r is not None:
+        pre = pre + r.astype(jnp.float32)
+    if act == "relu":
+        pre = jnp.maximum(pre, 0.0)
+    return pre
+
+
+def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act):
+    import jax
+    import jax.numpy as jnp
+
+    a = _prologue(x_ref[...], params_ref[...], eps, act)
+    w = w_ref[...]
+    out_ref[...] = jax.lax.dot_general(
+        a.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+
+
+def _fwd_kernel_res(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act):
+    import jax
+    import jax.numpy as jnp
+
+    a = _prologue(x_ref[...], params_ref[...], eps, act, r=r_ref[...])
+    w = w_ref[...]
+    out_ref[...] = jax.lax.dot_general(
+        a.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+
+
+def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
+                *, eps, act):
+    _bwd_body(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
+              None, eps=eps, act=act)
+
+
+def _bwd_kernel_res(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref,
+                    dw_ref, dgb_ref, dr_ref, *, eps, act):
+    _bwd_body(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
+              dr_ref, eps=eps, act=act, r_ref=r_ref)
+
+
+def _bwd_body(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
+              dr_ref, *, eps, act, r_ref=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        dgb_ref[...] = jnp.zeros_like(dgb_ref)
+
+    params = params_ref[...]
+    g, _, mu, var = (params[j] for j in range(4))
+    inv = jax.lax.rsqrt(var + eps)
+    x32 = x_ref[...].astype(jnp.float32)
+    xhat = (x32 - mu) * inv
+    pre = xhat * g + params[1]
+    if r_ref is not None:
+        pre = pre + r_ref[...].astype(jnp.float32)
+    a32 = jnp.maximum(pre, 0.0) if act == "relu" else pre
+    w = w_ref[...]
+    do = do_ref[...]
+
+    # dA = dOut @ W^T  (contract lanes of both: [bm,N]x[K,N] -> [bm,K])
+    dA = jax.lax.dot_general(
+        do.astype(w.dtype), w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dpre = jnp.where(pre > 0.0, dA, 0.0) if act == "relu" else dA
+    dx_ref[...] = (dpre * (g * inv)).astype(dx_ref.dtype)
+    if dr_ref is not None:
+        dr_ref[...] = dpre.astype(dr_ref.dtype)
+
+    # dW += A^T @ dOut  ([bm,K]x[bm,N] contracting bm -> [K,N], f32 acc)
+    dw_ref[...] += jax.lax.dot_general(
+        a32.astype(w.dtype), do.astype(w.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dgb_ref[0] += jnp.sum(dpre * xhat, axis=0)
+    dgb_ref[1] += jnp.sum(dpre, axis=0)
+
+
+def _pick_bm(M: int) -> int:
+    for bm in (512, 256, 128, 64, 32, 16, 8):
+        if M % bm == 0:
+            return bm
+    return M
+
+
+def eligible(M, K, N, dtype_bytes=2, train=True) -> bool:
+    """Kernel contract: lane-tiled K/N, sublane-tiled M, and (training)
+    the VMEM-resident accumulators must fit: dW f32 [K,N] + W [K,N] +
+    an X/dOut/dX working set."""
+    if K % 128 or N % 128 or M % 8:
+        return False
+    bm = _pick_bm(M)
+    work = bm * (2 * K + 2 * N) * dtype_bytes + bm * K * 4
+    if not train:
+        return K * N * dtype_bytes + work <= TRAIN_VMEM_BUDGET
+    return K * N * (4 + dtype_bytes) + work <= TRAIN_VMEM_BUDGET
+
+
+def bn_matmul_reference(x, gamma, beta, mean, var, w, r=None,
+                        act="relu", eps=1e-5):
+    """jnp reference/fallback: same math, XLA-fused where it can."""
+    import jax.numpy as jnp
+
+    sdt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    inv = 1.0 / jnp.sqrt(var.astype(sdt) + eps)
+    pre = (x.astype(sdt) - mean.astype(sdt)) * (inv * gamma.astype(sdt)) \
+        + beta.astype(sdt)
+    if r is not None:
+        pre = pre + r.astype(sdt)
+    if act == "relu":
+        pre = jnp.maximum(pre, 0.0)
+    return jnp.dot(pre.astype(w.dtype), w,
+                   preferred_element_type=sdt).astype(x.dtype)
+
+
+def bn_matmul_fwd(x, gamma, beta, mean, var, w, r=None, act="relu",
+                  eps=1e-5, interpret=False):
+    """x [M,K], w [K,N], params [K] f32, optional r [M,K] -> [M,N]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    M, K = x.shape
+    N = w.shape[1]
+    bm = _pick_bm(M)
+    params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((bm, K), lambda i: (i, 0)),
+        pl.BlockSpec((4, K), lambda i: (0, 0)),
+        pl.BlockSpec((K, N), lambda i: (0, 0)),
+    ]
+    args = [x, params, w]
+    if r is not None:
+        in_specs.append(pl.BlockSpec((bm, K), lambda i: (i, 0)))
+        args.append(r)
+        kern = functools.partial(_fwd_kernel_res, eps=eps, act=act)
+    else:
+        kern = functools.partial(_fwd_kernel, eps=eps, act=act)
+    return pl.pallas_call(
+        kern,
+        grid=(M // bm,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, N), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+def bn_matmul_bwd(x, gamma, beta, mean, var, w, do, r=None, act="relu",
+                  eps=1e-5, interpret=False):
+    """Single M-sweep fused backward.
+
+    Returns (dx, dgamma, dbeta, dmean, dvar, dw[, dr]) — the mean/var
+    cotangents come from the closed form
+        dmean = -invstd * gamma * dbeta
+        dvar  = -0.5 * invstd^2 * gamma * dgamma
+    (sums over M collapse onto the dgamma/dbeta accumulators)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    M, K = x.shape
+    N = w.shape[1]
+    bm = _pick_bm(M)
+    params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((bm, K), lambda i: (i, 0)),
+        pl.BlockSpec((4, K), lambda i: (0, 0)),
+        pl.BlockSpec((K, N), lambda i: (0, 0)),
+    ]
+    args = [x, params, w]
+    if r is not None:
+        in_specs.append(pl.BlockSpec((bm, K), lambda i: (i, 0)))
+        args.append(r)
+    in_specs.append(pl.BlockSpec((bm, N), lambda i: (i, 0)))
+    args.append(do)
+
+    out_specs = [
+        pl.BlockSpec((bm, K), lambda i: (i, 0)),     # dX
+        pl.BlockSpec((K, N), lambda i: (0, 0)),      # dW (resident acc)
+        pl.BlockSpec((2, K), lambda i: (0, 0)),      # dgamma/dbeta acc
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((M, K), x.dtype),
+        jax.ShapeDtypeStruct((K, N), jnp.float32),
+        jax.ShapeDtypeStruct((2, K), jnp.float32),
+    ]
+    if r is not None:
+        out_specs.append(pl.BlockSpec((bm, K), lambda i: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((M, K), r.dtype))
+        kern = functools.partial(_bwd_kernel_res, eps=eps, act=act)
+    else:
+        kern = functools.partial(_bwd_kernel, eps=eps, act=act)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(M // bm,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    dx, dw_f32, dgb = outs[0], outs[1], outs[2]
+    dgamma, dbeta = dgb[0], dgb[1]
+    inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)
+    dmean = -inv * gamma * dbeta
+    dvar = -0.5 * inv * inv * gamma * dgamma
+    dw = dw_f32.astype(w.dtype)
+    if r is not None:
+        return dx, dgamma, dbeta, dmean, dvar, dw, outs[3]
+    return dx, dgamma, dbeta, dmean, dvar, dw
+
+
+_TRAIN_CACHE = {}
+
+
+def make_bn_matmul_train(act="relu", eps=1e-5, has_residual=False,
+                         interpret=False):
+    """custom_vjp fused bn+act+matmul for training — generic_grad's
+    jax.vjp honors it like the flash/recurrence kernels.  Memoized per
+    config (fresh wrappers defeat jit function-identity caching)."""
+    key = (act, eps, has_residual, interpret)
+    cached = _TRAIN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import jax
+
+    if has_residual:
+        @jax.custom_vjp
+        def f(x, gamma, beta, mean, var, w, r):
+            return bn_matmul_fwd(x, gamma, beta, mean, var, w, r=r,
+                                 act=act, eps=eps, interpret=interpret)
+
+        def fwd(x, gamma, beta, mean, var, w, r):
+            out = f(x, gamma, beta, mean, var, w, r)
+            return out, (x, gamma, beta, mean, var, w, r)
+
+        def bwd(res, do):
+            x, gamma, beta, mean, var, w, r = res
+            return bn_matmul_bwd(x, gamma, beta, mean, var, w, do, r=r,
+                                 act=act, eps=eps, interpret=interpret)
+    else:
+        @jax.custom_vjp
+        def f(x, gamma, beta, mean, var, w):
+            return bn_matmul_fwd(x, gamma, beta, mean, var, w, act=act,
+                                 eps=eps, interpret=interpret)
+
+        def fwd(x, gamma, beta, mean, var, w):
+            out = f(x, gamma, beta, mean, var, w)
+            return out, (x, gamma, beta, mean, var, w)
+
+        def bwd(res, do):
+            x, gamma, beta, mean, var, w = res
+            return bn_matmul_bwd(x, gamma, beta, mean, var, w, do,
+                                 act=act, eps=eps, interpret=interpret)
+
+    f.defvjp(fwd, bwd)
+    _TRAIN_CACHE[key] = f
+    return f

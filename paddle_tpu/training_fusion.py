@@ -1,0 +1,161 @@
+"""Training-graph fusion pass: BatchNorm(+residual)+ReLU -> 1x1-conv
+prologue (Program -> Program, desc level).
+
+The ResNet roofline (docs/perf_resnet50_roofline.md) showed the train step
+HBM-bound with ~12.9 GB/step of elementwise fusion writes — the BN-apply /
+ReLU / residual-add chains between convolutions, materialized because XLA
+cannot fuse elementwise producers into its convolution custom-calls.  A
+1x1 convolution, however, is a matmul, and a Pallas matmul CAN normalize
+its operand tiles on load (ops/pallas_kernels/bn_matmul.py).  This pass
+rewrites every eligible
+
+    conv2d_1x1(relu(batch_norm(X)))                    # interior
+    conv2d_1x1(relu(batch_norm(X) + shortcut))         # block output
+
+into a fused `bn_act_conv1x1` op reading the RAW conv output X plus the
+batch statistics — the normalized activation never materializes for that
+consumer.  Nothing is removed: the original bn/add/relu ops stay for any
+remaining consumers (XLA duplicates cheap elementwise chains into
+consumer fusions and dead-code-eliminates the rest at compile time), so
+fetches keep working and ineligible consumers are unaffected.
+
+Gradients compose by chain rule: the pass flips the SavedMean/
+SavedVariance vars to differentiable (batch_norm already registers them
+as diffable outputs), so the fused op's dmean/dvar cotangents flow
+through batch_norm's generic jax.vjp back into dX — the full BN training
+gradient, float64-verified in tests/test_training_fusion.py.
+
+Counterpart of the reference's hand-fused CUDA epilogues (SURVEY.md
+§2.10); the inference-side analog is inference_transpiler.fuse_batch_norm.
+"""
+
+from __future__ import annotations
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v), int(v)]
+
+
+def _is_1x1_nhwc_conv(op, block) -> bool:
+    if op.type != "conv2d":
+        return False
+    if str(op.attrs.get("data_format", "NCHW")) != "NHWC":
+        return False
+    if int(op.attrs.get("groups", 1)) != 1:
+        return False
+    if _pair(op.attrs.get("paddings", [0, 0])) != [0, 0]:
+        return False
+    if _pair(op.attrs.get("dilations", [1, 1])) != [1, 1]:
+        return False
+    s = _pair(op.attrs.get("strides", [1, 1]))
+    if s not in ([1, 1], [2, 2]):
+        return False
+    w = block._find_var_recursive(op.inputs["Filter"][0])
+    return (w is not None and w.shape is not None
+            and tuple(w.shape[2:]) == (1, 1))
+
+
+def _trace_chain(t_name, producer, block):
+    """Walk conv.Input back through [relu] -> [elementwise_add] ->
+    batch_norm.  Returns (bn_op, act, residual_name) or None."""
+    act = None
+    op = producer.get(t_name)
+    if op is not None and op.type == "relu":
+        act = "relu"
+        op = producer.get(op.inputs["X"][0])
+    residual = None
+    if op is not None and op.type == "elementwise_add":
+        xn, yn = op.inputs["X"][0], op.inputs["Y"][0]
+        xv = block._find_var_recursive(xn)
+        yv = block._find_var_recursive(yn)
+        if (xv is None or yv is None or xv.shape is None
+                or tuple(xv.shape) != tuple(yv.shape or ())):
+            return None  # broadcasting add (e.g. a bias): not this pattern
+        px, py = producer.get(xn), producer.get(yn)
+        if px is not None and px.type == "batch_norm":
+            op, residual = px, yn
+        elif py is not None and py.type == "batch_norm":
+            op, residual = py, xn
+        else:
+            return None
+    if op is None or op.type != "batch_norm":
+        return None
+    if bool(op.attrs.get("is_test", False)):
+        return None  # inference BN folds via inference_transpiler instead
+    layout = str(op.attrs.get("data_layout",
+                              op.attrs.get("data_format", "NCHW")))
+    if layout != "NHWC":
+        return None
+    return op, act, residual
+
+
+def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
+    """Rewrite eligible 1x1 convs to fused bn_act_conv1x1 ops in place;
+    returns how many convs were fused.  Run BEFORE optimizer.minimize so
+    the backward pass differentiates the fused graph."""
+    from .framework import core
+    from .framework.core import Operator
+
+    if program is None:
+        program = core.default_main_program()
+    block = program.blocks[block_id]
+    for op in block.ops:
+        if op.type.endswith("_grad") or op.type == "generic_grad":
+            raise ValueError(
+                "fuse_bn_matmul must run before append_backward/minimize "
+                f"(found {op.type!r})")
+
+    producer = {}
+    for op in block.ops:
+        for names in op.outputs.values():
+            for n in names:
+                if n:
+                    producer[n] = op
+
+    fused = 0
+    new_ops = []
+    for op in block.ops:
+        if limit is not None and fused >= limit:
+            new_ops.append(op)
+            continue
+        if not _is_1x1_nhwc_conv(op, block):
+            new_ops.append(op)
+            continue
+        chain = _trace_chain(op.inputs["Input"][0], producer, block)
+        if chain is None:
+            new_ops.append(op)
+            continue
+        bn, act, residual = chain
+        saved_m = bn.outputs["SavedMean"][0]
+        saved_v = bn.outputs["SavedVariance"][0]
+        # the saved-stats vars are created stop_gradient (nothing read
+        # them before); the fused op's dmean/dvar cotangents must flow
+        # through them into batch_norm's vjp
+        for n in (saved_m, saved_v):
+            v = block._find_var_recursive(n)
+            if v is not None:
+                v.stop_gradient = False
+        ins = {"X": [bn.inputs["X"][0]],
+               "Scale": [bn.inputs["Scale"][0]],
+               "Bias": [bn.inputs["Bias"][0]],
+               "SavedMean": [saved_m],
+               "SavedVariance": [saved_v],
+               "Filter": [op.inputs["Filter"][0]]}
+        if residual is not None:
+            ins["Residual"] = [residual]
+        fused_op = Operator(
+            block, "bn_act_conv1x1",
+            inputs=ins,
+            outputs={"Output": [op.outputs["Output"][0]]},
+            attrs={"epsilon": float(bn.attrs.get("epsilon", 1e-5)),
+                   "act": act or "",
+                   "strides": _pair(op.attrs.get("strides", [1, 1]))})
+        fused_op.attrs.setdefault("__uid__", block.program._take_uid())
+        new_ops.append(fused_op)
+        fused += 1
+    if fused:
+        block.ops[:] = new_ops
+        block.program._bump()
+    return fused

@@ -34,7 +34,7 @@ PROBE_TIMEOUT = float(os.environ.get("EVIDENCE_PROBE_TIMEOUT", "90"))
 PROBE_INTERVAL = float(os.environ.get("EVIDENCE_PROBE_INTERVAL", "300"))
 sys.path.insert(0, REPO)
 from tools.probe_common import (  # noqa: E402
-    evidence_dir, json_lines, pause_file, probe_once)
+    PROBE_SRC, evidence_dir, json_lines, pause_file)
 
 OUT = evidence_dir(REPO)
 PAUSE_PATH = pause_file(REPO)
@@ -65,9 +65,40 @@ def log(rec):
 
 
 def probe():
-    rec = probe_once(PROBE_TIMEOUT)
-    log({"event": "probe", **{k: rec[k] for k in
-                              ("ok", "elapsed_s", "detail", "timed_out")}})
+    """Pause-interruptible probe: bench.py's stand-down must also abort an
+    IN-FLIGHT daemon probe (its subprocess holds the single-client TPU for
+    up to 90s — longer than bench's 12s grace window)."""
+    import time as _t
+
+    t0 = _t.monotonic()
+    p = subprocess.Popen([sys.executable, "-c", PROBE_SRC],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, start_new_session=True)
+    rec = None
+    while True:
+        try:
+            stdout, stderr = p.communicate(timeout=5)
+            ok = "PROBE_OK" in stdout
+            rec = {"ok": ok, "timed_out": False,
+                   "detail": (stdout.strip()[:200] if ok else
+                              (stderr.strip()[-300:] or f"rc={p.returncode}"))}
+            break
+        except subprocess.TimeoutExpired:
+            why = ("pause requested" if paused() else
+                   "timeout" if _t.monotonic() - t0 > PROBE_TIMEOUT else None)
+            if why is None:
+                continue
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.communicate()
+            rec = {"ok": False, "timed_out": why == "timeout",
+                   "detail": f"probe killed: {why} after "
+                             f"{_t.monotonic()-t0:.0f}s"}
+            break
+    rec["elapsed_s"] = round(_t.monotonic() - t0, 1)
+    log({"event": "probe", **rec})
     return rec["ok"]
 
 
@@ -138,6 +169,13 @@ CAPTURES = [
     ("ab_resnet_noremat",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_REMAT": "0"}, 420),
+    ("ab_resnet_bnfuse",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "resnet", "BENCH_FUSE_BN": "1"}, 420),
+    ("ab_resnet_bnfuse_noremat",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "resnet", "BENCH_FUSE_BN": "1", "BENCH_REMAT": "0"},
+     420),
     ("ab_resnet_nchw",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_LAYOUT": "NCHW"}, 420),
