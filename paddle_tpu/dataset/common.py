@@ -122,8 +122,34 @@ def fetch(url: str, module_name: str, md5sum: str | None,
     # auditable via data_provenance()
     if os.path.exists(path) and os.path.exists(path + ".provenance"):
         with open(path + ".provenance") as f:
-            DATA_PROVENANCE[module_name] = f.read().strip()
-        return path
+            prov = f.read().strip()
+        # integrity gate (ADVICE r3): the sidecar pins the sliver's own
+        # checksum (`sliver-md5: <hex>`, written by
+        # tests/fixtures/dataset_fixtures.py), so accidental drift or a
+        # corrupt/partial write is refused rather than silently served;
+        # a sidecar without a pin is accepted only under an explicit
+        # opt-in.  This is NOT tamper-proofing — the pin lives in the
+        # same writable dir as the data, so an author who can rewrite
+        # the bytes can rewrite the pin; provenance stays auditable via
+        # data_provenance(), it is not cryptographically bound.
+        pinned = next((l.split(":", 1)[1].strip()
+                       for l in prov.splitlines()
+                       if l.lower().startswith("sliver-md5:")), None)
+        if pinned is not None:
+            if md5file(path) != pinned:
+                raise IOError(
+                    f"{module_name}: pre-placed file {fname} does not match "
+                    f"its provenance sidecar checksum ({pinned}) — refusing "
+                    "tampered fixture bytes")
+        elif not os.environ.get("PADDLE_TPU_ALLOW_FIXTURES"):
+            print(f"[paddle_tpu.dataset] {module_name}: ignoring pre-placed "
+                  f"{fname}: its .provenance sidecar pins no sliver-md5 "
+                  "(set PADDLE_TPU_ALLOW_FIXTURES=1 to accept unchecked)",
+                  file=sys.stderr)
+            prov = None
+        if prov is not None:
+            DATA_PROVENANCE[module_name] = prov
+            return path
     if os.environ.get("PADDLE_TPU_OFFLINE"):
         return None
     try:
@@ -134,6 +160,57 @@ def fetch(url: str, module_name: str, md5sum: str | None,
         print(f"[paddle_tpu.dataset] {module_name}: real data unreachable "
               f"({url}); falling back to synthetic surrogate", file=sys.stderr)
         return None
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Convert a reader's samples to RecordIO shard files
+    `<name_prefix>-00000`, `-00001`, ... under `output_path` — the bridge
+    from any python reader to the master's chunk-task dispatch
+    (reference v2/dataset/common.py:193: every dataset module exports a
+    convert() built on this).  Records are pickled samples written
+    through the native RecordIO writer (paddle_tpu/native/recordio.py,
+    C++ chunked-CRC format when the native lib is built).
+
+    Returns the list of shard paths — pass it straight to
+    MasterClient.set_dataset for chunk dispatch, and read tasks back
+    with `recordio_task_loader` via distributed.master_reader."""
+    import pickle
+
+    from ..native import recordio as rio
+
+    assert line_count >= 1
+    os.makedirs(output_path, exist_ok=True)
+    paths = []
+    lines = []
+
+    def flush():
+        p = os.path.join(output_path, f"{name_prefix}-{len(paths):05d}")
+        with rio.Writer(p) as w:
+            for l in lines:
+                w.write(pickle.dumps(l, protocol=pickle.HIGHEST_PROTOCOL))
+        paths.append(p)
+        lines.clear()
+
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) >= line_count:
+            flush()
+    if lines or not paths:
+        flush()
+    return paths
+
+
+def recordio_task_loader(payload):
+    """Master-task loader over convert()'s shards: payload is a shard
+    path (or list of paths); yields the unpickled samples.  Plug into
+    distributed.master_reader(client, recordio_task_loader)."""
+    import pickle
+
+    from ..native.recordio import read_records
+
+    for path in ([payload] if isinstance(payload, str) else payload):
+        for rec in read_records(path):
+            yield pickle.loads(rec)
 
 
 def cluster_files_reader(files_pattern, trainer_count, trainer_id,

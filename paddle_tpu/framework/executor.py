@@ -137,14 +137,22 @@ def _enable_compilation_cache():
         fp = platform.machine()
         try:
             with open("/proc/cpuinfo") as f:
-                fp += next((l for l in f if l.startswith("flags")), "")
+                lines = f.read().splitlines()
+            # flags AND model name: two hosts can share a flag set yet
+            # get different XLA feature selections (observed: same-dir AOT
+            # entries with +prefer-no-gather the host lacks)
+            fp += next((l for l in lines if l.startswith("flags")), "")
+            fp += next((l for l in lines if l.startswith("model name")), "")
         except OSError:
             pass
         path = os.path.join(
             base, "pdtpu-" + hashlib.md5(fp.encode()).hexdigest()[:10])
         os.makedirs(path, exist_ok=True)
-        max_mb = int(os.environ.get("PADDLE_TPU_COMPILE_CACHE_MAX_MB",
-                                    "1024"))
+        try:
+            max_mb = int(os.environ.get("PADDLE_TPU_COMPILE_CACHE_MAX_MB",
+                                        "1024"))
+        except ValueError:  # a malformed override must not silently
+            max_mb = 1024   # disable the whole cache (ADVICE r3)
         # prune across ALL pdtpu-* subdirs: the size cap also ages out
         # trees left behind by other machine types
         _prune_cache_dir(base, max_mb * 1024 * 1024)

@@ -34,8 +34,15 @@ class InferenceTranspiler:
 
     FOLDABLE_PRODUCERS = ("conv2d", "depthwise_conv2d", "conv3d")
 
-    def transpile(self, program, scope, block_id: int = 0) -> int:
-        """Fold conv+BN pairs in place; returns how many were folded."""
+    def transpile(self, program, scope, block_id: int = 0,
+                  fetch_names=()) -> int:
+        """Fold conv+BN pairs in place; returns how many were folded.
+
+        After a fold the conv-output var holds the GAMMA-RESCALED conv
+        result, not the raw convolution: pass any vars you intend to
+        fetch via `fetch_names` and folds touching them are skipped
+        (ADVICE r3: op-level use counts alone cannot see fetch targets).
+        Persistable conv outputs are skipped for the same reason."""
         # same training predicate as the executor's is_test inference
         # (executor.py) plus the full optimizer-op set: an unlisted
         # optimizer slipping through would bake running stats into a
@@ -50,10 +57,10 @@ class InferenceTranspiler:
                     "fuse_batch_norm expects an inference-only program "
                     f"(found {op.type!r}); build it via "
                     "clone(for_test=True) or load_inference_model")
-        return self._fuse_batch_norm(block, scope)
+        return self._fuse_batch_norm(block, scope, set(fetch_names))
 
     # ------------------------------------------------------------------
-    def _fuse_batch_norm(self, block, scope) -> int:
+    def _fuse_batch_norm(self, block, scope, fetch_names=frozenset()) -> int:
         from .framework.core import Operator
 
         use_count: dict = {}
@@ -76,7 +83,8 @@ class InferenceTranspiler:
                 continue
             x = op.inputs["X"][0]
             conv = producer.get(x)
-            vals = self._gather(op, conv, scope, use_count)
+            vals = self._gather(op, conv, scope, use_count, fetch_names,
+                                block)
             if vals is None:
                 new_ops.append(op)
                 continue
@@ -130,13 +138,20 @@ class InferenceTranspiler:
         return folded
 
     # ------------------------------------------------------------------
-    def _gather(self, bn_op, conv, scope, use_count):
+    def _gather(self, bn_op, conv, scope, use_count, fetch_names=frozenset(),
+                block=None):
         """Scope values needed for the fold, or None if ineligible."""
         if conv is None or conv.type not in self.FOLDABLE_PRODUCERS:
             return None
         x = bn_op.inputs["X"][0]
         if use_count.get(x, 0) != 1:
             return None  # someone else reads the un-normalized conv out
+        if x in fetch_names:
+            return None  # fetched post-fold it would be the rescaled conv
+        if block is not None:
+            xv = block._find_var_recursive(x)
+            if xv is not None and xv.persistable:
+                return None  # saved models must keep the raw conv value
         filt = conv.inputs["Filter"][0]
         if use_count.get(filt, 0) != 1:
             return None  # weight sharing: rescaling would corrupt the twin
@@ -153,6 +168,10 @@ class InferenceTranspiler:
         return (np.asarray(w), *parts)
 
 
-def fuse_batch_norm(program, scope, block_id: int = 0) -> int:
-    """Module-level convenience: InferenceTranspiler().transpile(...)."""
-    return InferenceTranspiler().transpile(program, scope, block_id)
+def fuse_batch_norm(program, scope, block_id: int = 0,
+                    fetch_names=()) -> int:
+    """Module-level convenience: InferenceTranspiler().transpile(...).
+    Pass the vars you will fetch as `fetch_names` — folds that would
+    change a fetched conv output's value are skipped."""
+    return InferenceTranspiler().transpile(program, scope, block_id,
+                                           fetch_names=fetch_names)

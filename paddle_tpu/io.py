@@ -119,7 +119,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         # set of folded weights per call) — folded values mask the
         # originals for the save below, then the overlay is garbage
         scope = Scope(parent=scope)
-        _fuse(inference_program, scope)
+        # the model's fetch targets must keep their raw values: a fold
+        # whose conv output is itself fetched is skipped (ADVICE r3)
+        _fuse(inference_program, scope, fetch_names=target_names)
     os.makedirs(dirname, exist_ok=True)
     meta = {
         "feed_var_names": list(feeded_var_names),

@@ -123,7 +123,8 @@ def scaled_dot_product_attention(ctx, ins, attrs):
             fl = on_tpu and ra.flash_ring_eligible(
                 q, mesh, "sp", causal=causal, is_train=not ctx.is_test)
             out = ra.ring_attention(q, k, v, mesh, axis_name="sp",
-                                    causal=causal, use_flash=fl)
+                                    causal=causal, use_flash=fl,
+                                    is_train=not ctx.is_test)
         else:
             raise ValueError(
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")

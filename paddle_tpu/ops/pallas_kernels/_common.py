@@ -73,8 +73,21 @@ MOSAIC_ERROR_SIGNATURES = ("Mosaic", "mosaic", "Pallas", "pallas",
 
 
 def is_mosaic_error(exc) -> bool:
+    """Primary signal: the exception's type/module identifies the Mosaic/
+    Pallas lowering stack; the stringified-message substrings stay as a
+    secondary heuristic only (ADVICE r3: an unrelated error whose message
+    merely mentions 'Pallas' must not permanently disable the fused
+    kernels — so the substring scan skips generic builtin exceptions
+    raised outside jax, e.g. a ValueError from user code quoting docs)."""
+    mod = type(exc).__module__ or ""
+    if any(k in mod for k in ("pallas", "mosaic", "tpu_custom_call")):
+        return True
     msg = f"{type(exc).__name__}: {exc}"
-    return any(s in msg for s in MOSAIC_ERROR_SIGNATURES)
+    if mod.startswith(("jax", "jaxlib")) or isinstance(exc, RuntimeError):
+        # XLA/PJRT surfaces Mosaic compile failures as jax errors or bare
+        # RuntimeError — message signatures are trustworthy there
+        return any(s in msg for s in MOSAIC_ERROR_SIGNATURES)
+    return False
 
 
 def reverse_within_length(x, lengths, pad_fill=None):

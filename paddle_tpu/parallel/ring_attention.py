@@ -80,29 +80,44 @@ def _ring_body(q, k, v, axis_name: str, causal: bool, scale: float):
     return (o / l[..., None]).astype(q.dtype)
 
 
-def _ring_body_flash(q, k, v, axis_name: str, S: int, scale: float,
-                     interpret: bool):
-    """Non-causal ring loop whose per-chunk attention runs the Pallas
-    flash kernel (VMEM-tiled online softmax — the [t,t] score block never
-    touches HBM).  Each step yields the chunk's normalized output plus its
+def _ring_flash_fwd(q, k, v, axis_name: str, S: int, scale: float,
+                    causal: bool, interpret: bool):
+    """Ring loop whose per-chunk attention runs the Pallas flash kernel
+    (VMEM-tiled online softmax — the [t,t] score block never touches
+    HBM).  Each step yields the chunk's normalized output plus its
     logsumexp; chunks merge exactly via the standard attention-merge
     identity  o = Σ_s o_s · exp(lse_s − lse_tot),  lse_tot = ⊕ lse_s.
-    Unrolled python loop (S is the static mesh-axis size) so each step is
-    one kernel launch + one ppermute hop."""
+
+    Causal under SPMD: the kernel's causal flag is static, but whether
+    the held chunk is past/diagonal/future depends on the traced
+    axis_index.  The ring schedule resolves it statically per STEP: after
+    s hops a device holds chunk (my − s) mod S, which is the diagonal iff
+    s == 0 (causal kernel), strictly past iff my >= s (full kernel), and
+    otherwise future — masked out by forcing its lse to −inf, so the
+    merge weight exp(lse_s − lse_tot) is exactly 0.  Future chunks still
+    run the (discarded) kernel: one SPMD program, no divergent control
+    flow; the cost is the standard unbalanced-causal-ring compute bubble.
+
+    Unrolled python loop (S is the static mesh-axis size): one kernel
+    launch + one ppermute hop per step.  Returns (out, lse_tot) — the
+    residuals the ring-level custom_vjp needs."""
     import jax.numpy as jnp
     from jax import lax
 
     from ..ops.pallas_kernels import flash_attention as fa
 
+    my = lax.axis_index(axis_name)
     o_acc = jnp.zeros(q.shape, jnp.float32)
     lse_acc = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
     k_cur, v_cur = k, v
     perm = [(i, (i + 1) % S) for i in range(S)]
-    for _ in range(S):
+    for s in range(S):
         out_s, lse_s = fa.flash_attention_fwd(
-            q, k_cur, v_cur, causal=False, scale=scale,
+            q, k_cur, v_cur, causal=causal and s == 0, scale=scale,
             interpret=interpret)
         lse_s = lse_s.reshape(lse_acc.shape).astype(jnp.float32)
+        if causal and s > 0:
+            lse_s = jnp.where(my >= s, lse_s, -jnp.inf)
         lse_new = jnp.logaddexp(lse_acc, lse_s)
         o_acc = (o_acc * jnp.exp(lse_acc - lse_new)[..., None]
                  + out_s.astype(jnp.float32)
@@ -110,22 +125,101 @@ def _ring_body_flash(q, k, v, axis_name: str, S: int, scale: float,
         lse_acc = lse_new
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
-    return o_acc.astype(q.dtype)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_bwd(q, k, v, out, lse, do, axis_name: str, S: int,
+                    scale: float, causal: bool, interpret: bool):
+    """Ring backward: dk/dv accumulators ROTATE WITH their k/v chunks, so
+    after S hops each chunk's gradient has collected every device's
+    contribution and is home again.  Per step the blockwise flash
+    backward runs against the TOTAL logsumexp (and the global
+    delta = Σ out·do it derives from `out`), which makes each per-chunk
+    p = exp(s − lse_tot) the exact global softmax probability — the same
+    identity the forward merge uses, transposed."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.pallas_kernels import flash_attention as fa
+
+    my = lax.axis_index(axis_name)
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    # lse arrives [B,H,t] (merge shape); the kernel wants [B*H, t]
+    lse_k = lse.reshape(-1, lse.shape[-1])
+    for s in range(S):
+        dq_s, dk_s, dv_s = fa.flash_attention_bwd(
+            q, k_cur, v_cur, out, lse_k, do,
+            causal=causal and s == 0, scale=scale, interpret=interpret)
+        if causal and s > 0:
+            take = my >= s  # future chunk: no contribution either way
+            dq_s = jnp.where(take, dq_s, 0)
+            dk_s = jnp.where(take, dk_s, 0)
+            dv_s = jnp.where(take, dv_s, 0)
+        dq_acc = dq_acc + dq_s.astype(jnp.float32)
+        dk_acc = dk_acc + dk_s.astype(jnp.float32)
+        dv_acc = dv_acc + dv_s.astype(jnp.float32)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_RING_TRAIN_CACHE = {}
+
+
+def make_ring_flash_train(axis_name: str, S: int, causal: bool,
+                          scale: float, interpret: bool = False):
+    """Ring-LEVEL custom_vjp (per-shard, applied inside shard_map): the
+    kernel-level wrapper can't ride the ring because the merge needs each
+    step's lse.  Memoized per config so jit's function-identity caching
+    holds across traces."""
+    key = (axis_name, S, causal, scale, interpret)
+    cached = _RING_TRAIN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import jax
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        out, _ = _ring_flash_fwd(q, k, v, axis_name, S, scale, causal,
+                                 interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_flash_fwd(q, k, v, axis_name, S, scale, causal,
+                                   interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _ring_flash_bwd(q, k, v, out, lse, do, axis_name, S, scale,
+                               causal, interpret)
+
+    f.defvjp(fwd, bwd)
+    _RING_TRAIN_CACHE[key] = f
+    return f
 
 
 def flash_ring_eligible(q, mesh, axis_name: str, causal: bool,
                         is_train: bool) -> bool:
-    """Static gate for the flash-kernel ring path: inference-only (the
-    merge needs lse, which the custom_vjp wrapper doesn't expose through
-    the ring), non-causal only (under SPMD every device runs one program,
-    but the causal past/diagonal/future chunk split depends on
-    axis_index — a traced value — so the kernel's static causal flag
-    can't follow it), lane-width head dim, 128-tile chunks."""
+    """Static gate for the flash-kernel ring path: lane-width head dim
+    and 128-tile chunks.  Causal rides the per-step static schedule
+    (diagonal at s=0, past for my >= s, future lse-masked) and training
+    rides the ring-level custom_vjp (_ring_flash_bwd) — both supported
+    since r4; `causal`/`is_train` remain parameters so callers keep a
+    single gate call site."""
+    del causal, is_train  # supported; kept for call-site stability
     from ..ops.pallas_kernels._common import kernels_enabled
 
     from .mesh import axis_size
 
-    if is_train or causal or not kernels_enabled():
+    if not kernels_enabled():
         return False
     S = axis_size(mesh, axis_name)
     B, H, T, D = q.shape
@@ -135,11 +229,14 @@ def flash_ring_eligible(q, mesh, axis_name: str, causal: bool,
 
 def ring_attention(q, k, v, mesh, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
-                   use_flash: bool = False, interpret: bool = False):
+                   use_flash: bool = False, is_train: bool = False,
+                   interpret: bool = False):
     """q,k,v [B,H,T,D] (T divisible by mesh['sp']) → [B,H,T,D], computed with
     the sequence axis sharded over `axis_name`.  `use_flash=True` (gate
     with flash_ring_eligible) runs each per-chunk attention as a Pallas
-    flash kernel and merges chunks by logsumexp."""
+    flash kernel and merges chunks by logsumexp — including causal (per-
+    step static schedule) and training (`is_train=True`: the ring-level
+    custom_vjp whose backward rotates dk/dv with their chunks)."""
     import jax
 
     from .mesh import get_shard_map
@@ -151,16 +248,16 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(None, None, axis_name, None)
     if use_flash:
-        if causal:
-            raise ValueError(
-                "ring_attention(use_flash=True) does not support causal "
-                "masking (the past/diagonal/future chunk split depends on "
-                "the traced axis_index; see flash_ring_eligible) — call "
-                "with use_flash=False")
         from .mesh import axis_size
-        body = functools.partial(_ring_body_flash, axis_name=axis_name,
-                                 S=axis_size(mesh, axis_name), scale=s,
-                                 interpret=interpret)
+
+        S = axis_size(mesh, axis_name)
+        if is_train:
+            body = make_ring_flash_train(axis_name, S, causal, s,
+                                         interpret=interpret)
+        else:
+            def body(q, k, v):
+                return _ring_flash_fwd(q, k, v, axis_name, S, s, causal,
+                                       interpret)[0]
     else:
         body = functools.partial(_ring_body, axis_name=axis_name,
                                  causal=causal, scale=s)

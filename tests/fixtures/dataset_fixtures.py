@@ -66,6 +66,12 @@ def make_mnist_sliver(data_home: str, train_n: int = 1500) -> str:
         _write_idx3(os.path.join(out, img_name), xs)
         _write_idx1(os.path.join(out, lab_name), ys)
         for name in (img_name, lab_name):
+            # the sliver-md5 line is the integrity pin fetch() verifies —
+            # a pre-placed file whose bytes drift from its sidecar is
+            # refused, not silently substituted (ADVICE r3)
+            from paddle_tpu.dataset.common import md5file
+
             with open(os.path.join(out, name) + ".provenance", "w") as f:
-                f.write(MNIST_PROVENANCE)
+                f.write(MNIST_PROVENANCE.rstrip("\n") + "\n"
+                        f"sliver-md5: {md5file(os.path.join(out, name))}\n")
     return out

@@ -506,11 +506,34 @@ def test_fetch_accepts_provenance_marked_sliver(data_home, monkeypatch):
     # unmarked + md5 mismatch -> rejected (offline returns None)
     assert common.fetch("http://x/data.bin", mod, "0" * 32) is None
 
+    import hashlib
+    sliver_md5 = hashlib.md5(b"sliver bytes").hexdigest()
+
+    # sidecar WITHOUT an integrity pin: rejected unless explicitly opted
+    # in (ADVICE r3: a writable cache dir must not swap dataset bytes
+    # unchecked)
     with open(path + ".provenance", "w") as f:
         f.write("real sliver from corpus X")
+    assert common.fetch("http://x/data.bin", mod, "0" * 32) is None
+    monkeypatch.setenv("PADDLE_TPU_ALLOW_FIXTURES", "1")
+    assert common.fetch("http://x/data.bin", mod, "0" * 32) == path
+    monkeypatch.delenv("PADDLE_TPU_ALLOW_FIXTURES")
+
+    # pinned sidecar: accepted when the bytes match...
+    with open(path + ".provenance", "w") as f:
+        f.write(f"real sliver from corpus X\nsliver-md5: {sliver_md5}")
     got = common.fetch("http://x/data.bin", mod, "0" * 32)
     assert got == path
-    assert common.data_provenance(mod) == "real sliver from corpus X"
+    assert common.data_provenance(mod).startswith(
+        "real sliver from corpus X")
+
+    # ...and refused loudly when they don't (tampered fixture)
+    with open(path, "wb") as f:
+        f.write(b"tampered bytes!")
+    with pytest.raises(IOError):
+        common.fetch("http://x/data.bin", mod, "0" * 32)
+    with open(path, "wb") as f:
+        f.write(b"sliver bytes")
 
     # an md5-verified original clears the provenance marker
     import hashlib

@@ -89,3 +89,58 @@ def test_sharding_and_master_integration(tmp_path):
         if len(got) >= 60:
             break
     assert sorted(got) == sorted(samples)
+
+
+def test_dataset_convert_trains_through_master_chunks(tmp_path):
+    """The full reference pipeline (v2/dataset/common.py:193 convert ->
+    go/master chunk dispatch -> trainer): convert a reader to RecordIO
+    shards, register them as the master's dataset, and train a regression
+    through master-dispatched chunk tasks until the stream is exhausted."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.dataset.common import convert, recordio_task_loader
+    from paddle_tpu.distributed import MasterService, master_reader
+
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(8).astype("float32")
+
+    def reader():
+        for i in range(120):
+            x = rng.rand(8).astype("float32")
+            yield (x, np.float32(x @ w_true))
+
+    shards = convert(str(tmp_path), reader, 25, "reg_train")
+    assert len(shards) == 5  # 120 samples / 25 per shard, tail included
+    svc = MasterService(timeout_s=60)
+    svc.set_dataset(shards)
+
+    class _C:  # in-proc client shim (TCP path covered elsewhere)
+        get_task = staticmethod(svc.get_task)
+        task_finished = staticmethod(svc.task_finished)
+        task_failed = staticmethod(svc.task_failed)
+
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+
+    losses, batch, seen = [], [], 0
+    for xs, ys in master_reader(_C(), recordio_task_loader)():
+        batch.append((xs, ys))
+        seen += 1
+        if len(batch) == 20:
+            xb = np.stack([b[0] for b in batch])
+            yb = np.asarray([[b[1]] for b in batch], dtype="float32")
+            (l,) = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+            batch = []
+        if seen == 120:
+            break  # one epoch: the master re-queues tasks per pass
+    assert seen == 120  # every converted sample arrived exactly once
+    assert losses[-1] < losses[0]  # and the model actually trained

@@ -195,19 +195,61 @@ def test_ulysses_flash_matches_dense_and_grads():
 
 
 def test_flash_sp_eligibility_gates():
-    """The static gates hold the kernel to its contract: causal/training
-    ring, non-tile chunks, and wide heads all fall back to dense."""
+    """The static gates hold the kernel to its contract: non-tile chunks
+    and wide heads fall back to dense; causal and training ring are
+    eligible since r4 (static per-step schedule + ring-level vjp)."""
     from paddle_tpu.parallel.ring_attention import (flash_ring_eligible,
                                                     flash_ulysses_eligible)
 
     mesh = make_mesh({"sp": 2})
     q, _, _ = _qkv(B=1, H=2, T=256, D=32)
     assert flash_ring_eligible(q, mesh, "sp", False, False)
-    assert not flash_ring_eligible(q, mesh, "sp", True, False)  # causal
-    assert not flash_ring_eligible(q, mesh, "sp", False, True)  # training
+    assert flash_ring_eligible(q, mesh, "sp", True, False)   # causal: r4
+    assert flash_ring_eligible(q, mesh, "sp", False, True)   # train: r4
     short, _, _ = _qkv(B=1, H=2, T=64, D=32)  # 32-step chunks: not tiles
     assert not flash_ring_eligible(short, mesh, "sp", False, False)
     assert not flash_ulysses_eligible(short, mesh, "sp")
     wide, _, _ = _qkv(B=1, H=2, T=256, D=256)  # D > one lane tile
     assert not flash_ring_eligible(wide, mesh, "sp", False, False)
     assert not flash_ulysses_eligible(wide, mesh, "sp")
+
+
+def test_ring_flash_causal_matches_dense():
+    """Causal flash ring (diagonal causal kernel at s=0, full kernel for
+    past chunks, lse-masked future) vs dense causal attention."""
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=256, D=32)
+    dense = attention(q, k, v, causal=True)
+    flash = ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_causal_train_matches_dense(causal):
+    """Training through the ring-level custom_vjp (backward rotates dk/dv
+    with their chunks against the total logsumexp): gradient parity vs
+    dense for both causal and non-causal."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=256, D=32)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=causal) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(ring_attention(
+            q, k, v, mesh, causal=causal, use_flash=True, is_train=True,
+            interpret=True) ** 2)
+
+    assert np.allclose(loss_flash(q, k, v), loss_dense(q, k, v),
+                       rtol=2e-4)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gd, gf):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name}")

@@ -11,6 +11,8 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu import layers, utils
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_plotcurve_extracts_rows():
     log = _io.StringIO(
@@ -112,3 +114,42 @@ def test_torch2paddle_embedding_not_transposed():
         table.state_dict(), name_map={"weight": "t2p_emb"})
     np.testing.assert_allclose(fluid.global_scope().find_np("t2p_emb"),
                                table.weight.detach().numpy(), rtol=1e-6)
+
+
+def test_cluster_launch_local(tmp_path):
+    """tools/cluster_launch.py: one command spawns N localhost trainer
+    processes with the PADDLE_* env contract (+ a pserver process whose
+    endpoint reaches trainers), streams tagged logs, and reports rc."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os\n"
+        "print('RANK', os.environ['PADDLE_TRAINER_ID'],\n"
+        "      'WORLD', os.environ['PADDLE_TRAINERS'],\n"
+        "      'COORD', os.environ['PADDLE_COORDINATOR'],\n"
+        "      'PS', os.environ.get('PADDLE_PSERVERS', '-'))\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cluster_launch.py"),
+         "--nproc-per-host", "2", "--pservers", "1",
+         "--pserver-base-port", "7911",
+         "--job-dir", str(tmp_path), str(script)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if "RANK" in l]
+    assert len(lines) == 2
+    assert any("[localhost:0] RANK 0 WORLD 2" in l for l in lines)
+    assert any("[localhost:1] RANK 1 WORLD 2" in l for l in lines)
+    assert all("PS 127.0.0.1:7911" in l for l in lines)
+
+    # a failing trainer fails the job
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cluster_launch.py"),
+         "--nproc-per-host", "2", "--job-dir", str(tmp_path), str(bad)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 1

@@ -128,7 +128,46 @@ def bench_flash():
           f"dense {_timeit(dense_step, q, k, v):.2f} ms")
 
 
+def bench_bn_matmul():
+    """Fused BN+ReLU->matmul vs the XLA-composed reference, fwd+bwd, on
+    the ResNet stage-4 next-conv1 shape (bs128: M=6272, K=2048, N=512 —
+    the biggest eligible fusion site)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import bn_matmul as bm
+
+    M, K, N = 6272, 2048, 512
+    rng = np.random.RandomState(3)
+    x = jnp.asarray((rng.randn(M, K) * 0.2).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    w = jnp.asarray((rng.randn(K, N) * 0.05).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    g = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+    mu = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
+    var = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    assert bm.eligible(M, K, N)
+    fused = bm.make_bn_matmul_train(act="relu")
+
+    @jax.jit
+    def fused_step(x, g, b, mu, var, w):
+        return jax.grad(
+            lambda *a: fused(*a).astype(jnp.float32).sum(),
+            argnums=(0, 5))(x, g, b, mu, var, w)
+
+    @jax.jit
+    def ref_step(x, g, b, mu, var, w):
+        return jax.grad(
+            lambda *a: bm.bn_matmul_reference(*a).astype(jnp.float32).sum(),
+            argnums=(0, 5))(x, g, b, mu, var, w)
+
+    print(f"bn_matmul train M{M} K{K} N{N} bf16: "
+          f"fused {_timeit(fused_step, x, g, b, mu, var, w):.2f} ms vs "
+          f"xla {_timeit(ref_step, x, g, b, mu, var, w):.2f} ms")
+
+
 if __name__ == "__main__":
     bench_lstm()
     bench_gru()
     bench_flash()
+    bench_bn_matmul()

@@ -185,6 +185,14 @@ CAPTURES = [
     ("ab_lstm_nofused",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "lstm", "PADDLE_TPU_NO_FUSED_KERNELS": "1"}, 300),
+    # real-chip HLO bytes/step for the roofline ledger: how much of the
+    # 12.9 GB of elementwise fusion writes the BN->conv fusion removes
+    ("hlo_bytes_tpu_unfused",
+     [sys.executable, "tools/hlo_analysis.py", "bytes", "--bs", "128",
+      "--tpu"], {}, 900),
+    ("hlo_bytes_tpu_fused",
+     [sys.executable, "tools/hlo_analysis.py", "bytes", "--bs", "128",
+      "--tpu", "--fuse-bn"], {}, 900),
 ]
 
 

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Static HLO analysis: materialized-buffer bytes and collective (ICI)
+traffic of compiled training steps (VERDICT r3 Next #2/#8).
+
+Two jobs, one methodology (parse XLA's post-optimization HLO dump):
+
+  bytes        per-op-kind materialized output bytes of the ResNet-50
+               train step — the evidence artifact for the BN->conv
+               fusion work (docs/perf_resnet50_roofline.md counted
+               12.9 GB/step of elementwise fusion writes; this tool
+               measures how the training_fusion pass moves that number)
+  collectives  per-mode collective op counts + buffer bytes for the
+               multi-chip programs (dp / sp-ring / sp-ulysses / ep) on
+               the 8-virtual-device CPU mesh — the honest substitute for
+               scale-out numbers a single-chip environment cannot
+               produce.  Collective BUFFER bytes are reported; actual
+               wire traffic per algorithm (ring all-reduce ~2x bytes,
+               all-gather (S-1)/S x bytes...) is noted per row.
+
+Usage:
+  python tools/hlo_analysis.py bytes [--fuse-bn] [--no-remat] [--bs N]
+  python tools/hlo_analysis.py collectives [--mode dp|sp_ring|sp_ulysses|ep]
+  python tools/hlo_analysis.py all   # everything, JSON per line
+
+The workload runs in a re-exec'd child with XLA_FLAGS=--xla_dump_to so
+the flags are set before jax imports; the parent parses the dump.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+               "f32": 4, "s32": 4, "u32": 4,
+               "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+               "s8": 1, "u8": 1, "pred": 1,
+               "f8e4m3fn": 1, "f8e5m2": 1, "s4": 1, "u4": 1}
+
+_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+# "%name = <shape or tuple> kind(" — kind is the first identifier after
+# the closing of the shape spec
+_OPLINE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (\(?.*?\)?\{?[^=]*?)"
+                     r"\s([a-z][\w\-]*)\(")
+
+COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all", "collective-broadcast")
+
+
+def shape_bytes(spec: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE.findall(spec):
+        if dt not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES[dt]
+    return total
+
+
+def parse_module(path: str):
+    """Per-kind {count, out_bytes} + per-collective instances."""
+    kinds = {}
+    colls = []
+    with open(path) as f:
+        for line in f:
+            m = _OPLINE.match(line)
+            if not m:
+                continue
+            spec, kind = m.groups()
+            b = shape_bytes(spec)
+            k = kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
+            k["count"] += 1
+            k["out_bytes"] += b
+            if kind in COLLECTIVES:
+                colls.append({"op": kind, "out_bytes": b,
+                              "shape": spec.strip()[:120]})
+    return kinds, colls
+
+
+def find_main_module(dump_dir: str, markers) -> str:
+    """The training-step module among the dumps: the startup program can
+    be LARGER than the step (parameter-init RNG), so size alone picks
+    wrong — score by occurrences of mode-relevant markers (collective ops
+    / convolutions), size as tie-break."""
+    cands = (glob.glob(os.path.join(dump_dir, "*after_optimizations.txt"))
+             or glob.glob(os.path.join(dump_dir, "*.txt")))
+    if not cands:
+        raise FileNotFoundError(f"no HLO dumps under {dump_dir}")
+
+    def score(path):
+        txt = open(path).read()
+        return (sum(txt.count(f" {m}(") for m in markers),
+                os.path.getsize(path))
+
+    return max(cands, key=score)
+
+
+def run_child(mode: str, dump_dir: str, args) -> None:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                       + f" --xla_dump_to={dump_dir}").strip()
+    if mode != "bytes":
+        # multi-chip modes always use the virtual CPU mesh
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    elif args.tpu:
+        # leave platform selection to the environment's accelerator
+        # plugin (the real-chip bytes run the roofline doc wants)
+        env.pop("JAX_PLATFORMS", None)
+    elif not os.environ.get("JAX_PLATFORMS"):
+        # bytes mode: honor an explicit JAX_PLATFORMS (TPU when the
+        # tunnel is up) but DEFAULT to cpu — inheriting a wedged
+        # accelerator plugin would hang the child silently
+        env["JAX_PLATFORMS"] = "cpu"
+    argv = [sys.executable, os.path.abspath(__file__), "--child", mode,
+            "--bs", str(args.bs), "--image", str(args.image)]
+    if args.fuse_bn:
+        argv.append("--fuse-bn")
+    if args.no_remat:
+        argv.append("--no-remat")
+    if args.submode:
+        argv += ["--mode", args.submode]
+    p = subprocess.run(argv, env=env, capture_output=True, text=True,
+                       timeout=args.timeout)
+    if p.returncode != 0:
+        raise RuntimeError(f"child {mode} failed rc={p.returncode}:\n"
+                           f"{p.stderr[-2000:]}")
+
+
+# --------------------------------------------------------------- workloads
+def child_bytes(args) -> None:
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    hw = args.image
+    avg_cost, _ = resnet.build_train_program(
+        batch_size=args.bs, depth=50, dtype="bfloat16", layout="NHWC",
+        image_shape=(3, hw, hw), remat=not args.no_remat,
+        fuse_bn=args.fuse_bn)
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(args.bs, hw, hw, 3).astype("float32"),
+            "label": rng.randint(0, 1000, (args.bs, 1)).astype("int64")}
+    exe.run(feed=feed, fetch_list=[avg_cost])
+    print("CHILD_OK")
+
+
+def child_collectives(mode: str) -> None:
+    """One multi-chip training step on the 8-virtual-CPU mesh (the same
+    program shapes dryrun_multichip validates)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import ParallelExecutor
+
+    rng = np.random.RandomState(0)
+    if mode == "dp":
+        img = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        lab = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=256, act="relu")
+        h = fluid.layers.fc(input=h, size=256, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(input=h, size=16), lab))
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9) \
+            .minimize(loss)
+        pe = ParallelExecutor(axes={"dp": 8})
+        pe.run(fluid.default_startup_program())
+        pe.run(feed={"x": rng.rand(32, 64).astype("float32"),
+                     "y": rng.randint(0, 16, (32, 1)).astype("int64")},
+               fetch_list=[loss])
+    elif mode in ("sp_ring", "sp_ulysses"):
+        T, D = 256, 32
+        seq = fluid.layers.data(name="seq", shape=[T, D], dtype="float32")
+        lab = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        attn = fluid.layers.multi_head_attention(
+            seq, seq, seq, num_heads=4, causal=True,
+            sp_mode="ring" if mode == "sp_ring" else "alltoall")
+        flat = fluid.layers.reshape(
+            fluid.layers.elementwise_add(seq, attn), [-1, T * D])
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(input=flat, size=10), lab))
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9) \
+            .minimize(loss)
+        pe = ParallelExecutor(axes={"dp": 4, "sp": 2})
+        pe.run(fluid.default_startup_program())
+        pe.run(feed={"seq": rng.rand(8, T, D).astype("float32"),
+                     "y": rng.randint(0, 10, (8, 1)).astype("int64")},
+               fetch_list=[loss])
+    elif mode == "ep":
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[64], dtype="float32")
+        out = fluid.layers.moe(x, num_experts=4, d_hidden=128,
+                               capacity_factor=2.0)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=out, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        pe = ParallelExecutor(axes={"ep": 4, "dp": 2})
+        pe.run(fluid.default_startup_program())
+        xm = rng.rand(64, 64).astype("float32")
+        pe.run(feed={"x": xm, "y": 2 * xm}, fetch_list=[loss])
+    else:
+        raise ValueError(mode)
+    print("CHILD_OK")
+
+
+# ------------------------------------------------------------------ driver
+def analyze(mode: str, args) -> dict:
+    with tempfile.TemporaryDirectory(prefix=f"hlo_{mode}_") as dump:
+        run_child("bytes" if mode == "bytes" else "collectives", dump,
+                  args)
+        module = find_main_module(
+            dump, COLLECTIVES if mode != "bytes"
+            else ("convolution", "custom-call"))
+        kinds, colls = parse_module(module)
+    total = sum(k["out_bytes"] for k in kinds.values())
+    rec = {
+        "analysis": mode if mode == "bytes" else f"collectives:{args.submode}",
+        "module": os.path.basename(module),
+        "total_out_bytes": total,
+        "by_kind": {k: v for k, v in sorted(
+            kinds.items(), key=lambda kv: -kv[1]["out_bytes"])
+            if v["out_bytes"] > total * 0.001 or k in COLLECTIVES},
+    }
+    if mode == "bytes":
+        rec["config"] = {"bs": args.bs, "fuse_bn": args.fuse_bn,
+                         "remat": not args.no_remat}
+        rec["fusion_bytes"] = kinds.get("fusion", {}).get("out_bytes", 0)
+        rec["conv_bytes"] = (
+            kinds.get("convolution", {}).get("out_bytes", 0)
+            + kinds.get("custom-call", {}).get("out_bytes", 0))
+    else:
+        per = {}
+        for c in colls:
+            e = per.setdefault(c["op"], {"count": 0, "buffer_bytes": 0})
+            e["count"] += 1
+            e["buffer_bytes"] += c["out_bytes"]
+        rec["collectives"] = per
+        rec["note"] = ("buffer bytes, not wire bytes: ring all-reduce "
+                       "moves ~2x buffer over ICI, all-gather/reduce-"
+                       "scatter ~(S-1)/S x, collective-permute ~1x")
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("what", nargs="?", default="all",
+                    choices=["bytes", "collectives", "all"])
+    ap.add_argument("--child", default=None)
+    ap.add_argument("--mode", dest="submode", default=None)
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--image", type=int, default=224,
+                    help="input height/width (a CPU evidence run wants a "
+                         "small proxy; the chip capture keeps 224)")
+    ap.add_argument("--timeout", type=float, default=1800)
+    ap.add_argument("--fuse-bn", action="store_true")
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--tpu", action="store_true",
+                    help="bytes mode: use the environment's accelerator "
+                         "instead of defaulting to cpu")
+    args = ap.parse_args()
+
+    if args.child:
+        if args.child == "bytes":
+            child_bytes(args)
+        else:
+            child_collectives(args.submode)
+        return
+
+    if args.what in ("bytes", "all"):
+        for fuse in ((False, True) if args.what == "all"
+                     else (args.fuse_bn,)):
+            args.fuse_bn = fuse
+            print(json.dumps(analyze("bytes", args)), flush=True)
+    if args.what in ("collectives", "all"):
+        modes = ([args.submode] if args.submode
+                 else ["dp", "sp_ring", "sp_ulysses", "ep"])
+        for m in modes:
+            args.submode = m
+            print(json.dumps(analyze("collectives", args)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

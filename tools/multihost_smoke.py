@@ -73,8 +73,13 @@ def child(pid: int, n: int, coordinator: str):
     print("LOSSES " + json.dumps(losses), flush=True)
 
 
-def main():
+def main(attempt: int = 0):
     n = int(os.environ.get("SMOKE_TRAINERS", "2"))
+    # bind-then-close is a TOCTOU race (ADVICE r3: another process can
+    # grab the port before the coordinator child does) — kept because the
+    # coordinator must bind the SAME port itself, but made safe by
+    # retrying the whole smoke on a fresh port when the coordinator's
+    # bind fails
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         coordinator = f"127.0.0.1:{s.getsockname()[1]}"
@@ -97,6 +102,16 @@ def main():
             ok = False
             continue
         if p.returncode != 0:
+            bind_lost = any(sig in err for sig in
+                            ("Address already in use", "Failed to bind",
+                             "address in use"))
+            if bind_lost and attempt < 3:
+                for q in procs:
+                    q.kill()
+                print(f"[proc {pid}] coordinator port lost to the TOCTOU "
+                      f"race; retrying on a fresh port "
+                      f"(attempt {attempt + 1}/3)")
+                return main(attempt + 1)
             print(f"[proc {pid}] rc={p.returncode}; stderr tail:\n"
                   f"{err[-800:]}")
             ok = False
