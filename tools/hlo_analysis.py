@@ -93,7 +93,8 @@ def find_main_module(dump_dir: str, markers) -> str:
     wrong — score by occurrences of mode-relevant markers (collective ops
     / convolutions), size as tie-break."""
     cands = (glob.glob(os.path.join(dump_dir, "*after_optimizations.txt"))
-             or glob.glob(os.path.join(dump_dir, "*.txt")))
+             or [f for f in glob.glob(os.path.join(dump_dir, "*.txt"))
+                 if not os.path.basename(f).startswith("child_")])
     if not cands:
         raise FileNotFoundError(f"no HLO dumps under {dump_dir}")
 
@@ -107,6 +108,8 @@ def find_main_module(dump_dir: str, markers) -> str:
 
 def run_child(mode: str, dump_dir: str, args) -> None:
     env = dict(os.environ)
+    env["PYTHONFAULTHANDLER"] = "1"  # SIGABRT dumps the stack to the
+    # child_stderr file — cheap diagnosability for wedged children
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                        + f" --xla_dump_to={dump_dir}").strip()
     if mode != "bytes":
@@ -130,11 +133,44 @@ def run_child(mode: str, dump_dir: str, args) -> None:
         argv.append("--no-remat")
     if args.submode:
         argv += ["--mode", args.submode]
-    p = subprocess.run(argv, env=env, capture_output=True, text=True,
-                       timeout=args.timeout)
-    if p.returncode != 0:
-        raise RuntimeError(f"child {mode} failed rc={p.returncode}:\n"
-                           f"{p.stderr[-2000:]}")
+    # FILE-redirected output, not pipes: children of this environment's
+    # python intermittently wedge when their (very chatty, multi-KB-line
+    # cpu_aot_loader) stderr rides a subprocess PIPE; redirecting to a
+    # file in the dump dir is reliable (observed r4, mechanism in the
+    # XLA logging path, not ours)
+    out_path = os.path.join(dump_dir, "child_stdout.txt")
+    err_path = os.path.join(dump_dir, "child_stderr.txt")
+
+    def _tail(path, n=2000):
+        try:
+            with open(path, "rb") as f:
+                f.seek(max(0, os.path.getsize(path) - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return "<no stderr captured>"
+
+    with open(out_path, "w") as fo, open(err_path, "w") as fe:
+        proc = subprocess.Popen(argv, env=env, stdout=fo, stderr=fe)
+        try:
+            rc = proc.wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            # SIGABRT first: PYTHONFAULTHANDLER dumps the child's stack
+            # into child_stderr.txt — the whole point of the wedge
+            # diagnostics; then re-raise WITH the tail (the caller's
+            # TemporaryDirectory is about to delete the file)
+            proc.send_signal(subprocess.signal.SIGABRT)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            raise RuntimeError(
+                f"child {mode} timed out after {args.timeout:.0f}s; "
+                f"stderr tail (incl. faulthandler dump if any):\n"
+                f"{_tail(err_path, 4000)}")
+    if rc != 0:
+        raise RuntimeError(f"child {mode} failed rc={rc}:\n"
+                           f"{_tail(err_path)}")
 
 
 # --------------------------------------------------------------- workloads
