@@ -15,6 +15,9 @@ Usage: python -m paddle_tpu <subcommand> [args]
   master ...            — fault-tolerant task-dispatch service
                           (distributed/master; the Go master+etcd role,
                           with a file snapshot as the etcd replacement)
+  cluster_train ...     — one-command multi-host job launch
+                          (distributed/cluster_launch; the reference's
+                          scripts/cluster_train/paddle.py role)
 """
 
 from __future__ import annotations
@@ -210,7 +213,21 @@ def main(argv=None) -> int:
                    help="task-queue snapshot file (restart recovery)")
     p.set_defaults(fn=cmd_master)
 
-    args = parser.parse_args(argv)
+    # `paddle cluster_train ...` — one-command multi-host launch
+    # (reference paddle/scripts/cluster_train/paddle.py).  Dispatched
+    # BEFORE argparse: REMAINDER can't capture leading --options, and
+    # the launcher owns its whole argv anyway.
+    sub.add_parser(
+        "cluster_train",
+        help="launch a multi-host job (see distributed/cluster_launch.py)")
+
+    real_argv = sys.argv[1:] if argv is None else list(argv)
+    if real_argv[:1] == ["cluster_train"]:
+        from .distributed.cluster_launch import main as launch_main
+
+        return launch_main(real_argv[1:])
+
+    args = parser.parse_args(real_argv)
     return args.fn(args)
 
 

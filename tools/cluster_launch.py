@@ -1,163 +1,13 @@
 #!/usr/bin/env python
-"""One-command multi-host job launcher (reference
-paddle/scripts/cluster_train/paddle.py — fabric-dispatched pservers +
-trainers — rebuilt for the SPMD world: every process joins ONE
-jax.distributed mesh via the env contract in
-paddle_tpu/distributed/launch.py).
-
-    python tools/cluster_launch.py --hosts h1,h2 --nproc-per-host 4 \
-        [--pservers 2] train.py --lr 0.1
-
-For each host it starts `nproc-per-host` trainer processes with
-PADDLE_TRAINER_ID / PADDLE_TRAINERS / PADDLE_COORDINATOR set (process 0's
-host:port is the coordinator), plus optional parameter-server processes
-(`paddle pserver` CLI) whose host:port list reaches trainers as
-PADDLE_PSERVERS.  localhost processes spawn directly; remote hosts go
-through `ssh` (key-based auth assumed, job dir synced with scp -r unless
---no-sync) — the same command template either way, so what the smoke test
-exercises locally is what ssh runs remotely.
-
-Logs stream line-prefixed `[host:rank]`; SIGINT tears the whole job down
-(reference kill_process); exit code is non-zero if any process failed.
-"""
-
-from __future__ import annotations
-
-import argparse
+"""Shim: the launcher lives in paddle_tpu.distributed.cluster_launch
+(also exposed as `paddle cluster_train`); this path stays for muscle
+memory with the reference's paddle/scripts/cluster_train/paddle.py."""
 import os
-import shlex
-import signal
-import subprocess
 import sys
-import threading
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def _stream(proc, tag, sink):
-    for line in proc.stdout:
-        sink.write(f"[{tag}] {line}")
-        sink.flush()
-
-
-def _spawn(host, argv, env_extra, job_dir, no_sync, synced_hosts):
-    """Local exec or ssh exec with an identical env+command template."""
-    if host in ("localhost", "127.0.0.1"):
-        env = {**os.environ, **env_extra}
-        return subprocess.Popen(argv, env=env, cwd=job_dir,
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
-    if not no_sync and host not in synced_hosts:
-        subprocess.run(["scp", "-qr", job_dir,
-                        f"{host}:{os.path.dirname(job_dir) or '.'}"],
-                       check=True)
-        synced_hosts.add(host)
-    envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in env_extra.items())
-    remote = f"cd {shlex.quote(job_dir)} && {envs} " + \
-        " ".join(shlex.quote(a) for a in argv)
-    return subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        description="launch a multi-host paddle_tpu job from one command")
-    ap.add_argument("--hosts", default="localhost",
-                    help="comma-separated host list (default localhost)")
-    ap.add_argument("--nproc-per-host", type=int, default=1)
-    ap.add_argument("--coordinator-port", type=int, default=8476)
-    ap.add_argument("--pservers", type=int, default=0,
-                    help="parameter-server processes (round-robin over "
-                         "hosts, ports from --pserver-base-port)")
-    ap.add_argument("--pserver-base-port", type=int, default=7164)
-    ap.add_argument("--job-dir", default=os.getcwd(),
-                    help="working dir, scp'd to remote hosts unless "
-                         "--no-sync")
-    ap.add_argument("--no-sync", action="store_true")
-    ap.add_argument("script")
-    ap.add_argument("script_args", nargs=argparse.REMAINDER)
-    args = ap.parse_args(argv)
-
-    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
-    world = len(hosts) * args.nproc_per_host
-    # launcher-environment vars that must reach REMOTE processes too:
-    # ssh spawns don't inherit os.environ, and platform selection happens
-    # at interpreter startup (docs/cluster_howto.md gotcha) — dropping
-    # JAX_PLATFORMS would put remote ranks on a different backend than
-    # local ones
-    forwarded = {k: os.environ[k] for k in
-                 ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")
-                 if k in os.environ}
-    forwarded.update({k: v for k, v in os.environ.items()
-                      if k.startswith("PADDLE_TPU_")})
-    coord_host = "127.0.0.1" if hosts[0] in ("localhost", "127.0.0.1") \
-        else hosts[0]
-    coordinator = f"{coord_host}:{args.coordinator_port}"
-
-    procs = []
-    synced = set()
-    pserver_eps = []
-    for i in range(args.pservers):
-        host = hosts[i % len(hosts)]
-        port = args.pserver_base_port + i // len(hosts)
-        ep_host = "127.0.0.1" if host in ("localhost", "127.0.0.1") else host
-        pserver_eps.append(f"{ep_host}:{port}")
-        p = _spawn(host,
-                   [sys.executable, "-m", "paddle_tpu.cli", "pserver",
-                    "--host", "0.0.0.0", "--port", str(port)],
-                   {**forwarded,
-                    "PYTHONPATH": REPO + os.pathsep
-                    + os.environ.get("PYTHONPATH", "")},
-                   args.job_dir, args.no_sync, synced)
-        procs.append((f"{host}:ps{i}", p))
-
-    for hi, host in enumerate(hosts):
-        for r in range(args.nproc_per_host):
-            rank = hi * args.nproc_per_host + r
-            env_extra = {
-                **forwarded,
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS": str(world),
-                "PADDLE_COORDINATOR": coordinator,
-                "PYTHONPATH": REPO + os.pathsep
-                + os.environ.get("PYTHONPATH", ""),
-            }
-            if pserver_eps:
-                env_extra["PADDLE_PSERVERS"] = ",".join(pserver_eps)
-            p = _spawn(host, [sys.executable, args.script]
-                       + args.script_args, env_extra,
-                       args.job_dir, args.no_sync, synced)
-            procs.append((f"{host}:{rank}", p))
-
-    threads = [threading.Thread(target=_stream,
-                                args=(p, tag, sys.stdout), daemon=True)
-               for tag, p in procs]
-    for t in threads:
-        t.start()
-
-    def tear_down(*_):
-        for _, p in procs:
-            p.terminate()
-
-    signal.signal(signal.SIGINT, tear_down)
-    signal.signal(signal.SIGTERM, tear_down)
-
-    rc = 0
-    # trainers decide job success; pservers are serve-forever processes
-    # that get torn down once every trainer exits
-    trainer_procs = [(t, p) for t, p in procs if ":ps" not in t]
-    for tag, p in trainer_procs:
-        p.wait()
-        if p.returncode != 0:
-            print(f"[cluster_launch] {tag} exited rc={p.returncode}",
-                  file=sys.stderr)
-            rc = 1
-    tear_down()
-    for t in threads:
-        t.join(timeout=5)
-    return rc
-
+from paddle_tpu.distributed.cluster_launch import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())
