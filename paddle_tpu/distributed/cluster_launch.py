@@ -93,9 +93,19 @@ def main(argv=None):
                  if k in os.environ}
     forwarded.update({k: v for k, v in os.environ.items()
                       if k.startswith("PADDLE_TPU_")})
-    coord_host = "127.0.0.1" if hosts[0] in ("localhost", "127.0.0.1") \
-        else hosts[0]
-    coordinator = f"{coord_host}:{args.coordinator_port}"
+    # endpoint addressing: in a single-localhost job loopback is right;
+    # in a MIXED host list, remote ranks cannot reach "127.0.0.1", so
+    # endpoints advertise the machine's own hostname instead
+    import socket as _socket
+
+    all_local = all(h in ("localhost", "127.0.0.1") for h in hosts)
+
+    def _ep_host(h):
+        if h in ("localhost", "127.0.0.1"):
+            return "127.0.0.1" if all_local else _socket.gethostname()
+        return h
+
+    coordinator = f"{_ep_host(hosts[0])}:{args.coordinator_port}"
 
     procs = []
     synced = set()
@@ -103,8 +113,7 @@ def main(argv=None):
     for i in range(args.pservers):
         host = hosts[i % len(hosts)]
         port = args.pserver_base_port + i // len(hosts)
-        ep_host = "127.0.0.1" if host in ("localhost", "127.0.0.1") else host
-        pserver_eps.append(f"{ep_host}:{port}")
+        pserver_eps.append(f"{_ep_host(host)}:{port}")
         p = _spawn(host,
                    [sys.executable, "-m", "paddle_tpu.cli", "pserver",
                     "--host", "0.0.0.0", "--port", str(port)],
@@ -147,14 +156,36 @@ def main(argv=None):
 
     rc = 0
     # trainers decide job success; pservers are serve-forever processes
-    # that get torn down once every trainer exits
+    # torn down once every trainer exits — but a pserver DYING while
+    # trainers still run is a job failure (trainers would block on it
+    # forever), so the wait loop polls both
     trainer_procs = [(t, p) for t, p in procs if ":ps" not in t]
-    for tag, p in trainer_procs:
-        p.wait()
-        if p.returncode != 0:
-            print(f"[cluster_launch] {tag} exited rc={p.returncode}",
-                  file=sys.stderr)
-            rc = 1
+    pserver_procs = [(t, p) for t, p in procs if ":ps" in t]
+    pending = list(trainer_procs)
+    while pending:
+        still = []
+        for tag, p in pending:
+            r = p.poll()
+            if r is None:
+                still.append((tag, p))
+            elif r != 0:
+                print(f"[cluster_launch] {tag} exited rc={r}",
+                      file=sys.stderr)
+                rc = 1
+        for tag, p in pserver_procs:
+            r = p.poll()
+            if r is not None:
+                print(f"[cluster_launch] {tag} died rc={r} while "
+                      f"trainers were running; tearing the job down",
+                      file=sys.stderr)
+                tear_down()
+                rc = 1
+                still = []
+        pending = still
+        if pending:
+            import time
+
+            time.sleep(0.5)
     tear_down()
     for t in threads:
         t.join(timeout=5)

@@ -292,6 +292,42 @@ def bn_act_conv1x1(ctx, ins, attrs):
     return {"Output": [out2.reshape(n, h, ww, o)]}
 
 
+@register_op("bn_act_conv3x3")
+def bn_act_conv3x3(ctx, ins, attrs):
+    """Fused BatchNorm+act -> 3x3 convolution (NHWC, stride 1, pad 1):
+    bn_act_conv1x1's companion for the bottleneck's middle conv, backed
+    by ops/pallas_kernels/bn_conv.py (whole-image VMEM tiles, nine-tap
+    matmuls, single-N-sweep fused backward).  Created only by
+    training_fusion.fuse_bn_matmul; ineligible shapes fall back to
+    normalize + XLA conv — exactly the unfused semantics."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]           # [N,H,W,K] raw conv output (pre-BN)
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["SavedMean"][0], ins["SavedVariance"][0]
+    w = ins["Filter"][0]      # OIHW [O, K, 3, 3]
+    eps = float(attrs.get("epsilon", 1e-5))
+    act = attrs.get("act") or None
+
+    from .pallas_kernels import bn_conv as bcv
+    from .pallas_kernels._common import kernels_enabled
+
+    n, h, ww, k = x.shape
+    o = w.shape[0]
+    if (ctx.target_platform() == "tpu" and kernels_enabled()
+            and bcv.eligible(n, h, ww, k, o, x.dtype.itemsize,
+                             train=not ctx.is_test)):
+        f = bcv.make_bn_conv3x3_train(act=act, eps=eps)
+        out = f(x, scale.astype(jnp.float32), bias.astype(jnp.float32),
+                mean.astype(jnp.float32), var.astype(jnp.float32),
+                bcv._w_hwio(w))
+    else:
+        # the reference derives its stats dtype from x and casts params
+        out = bcv.bn_conv3x3_reference(x, scale, bias, mean, var, w,
+                                       act=act, eps=eps)
+    return {"Output": [out]}
+
+
 @register_op("layer_norm")
 def layer_norm(ctx, ins, attrs):
     import jax.numpy as jnp

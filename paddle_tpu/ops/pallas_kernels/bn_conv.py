@@ -1,0 +1,254 @@
+"""Fused BatchNorm+ReLU -> 3x3 convolution (stride 1, pad 1, NHWC) as a
+Pallas TPU kernel — the companion of bn_matmul.py that completes the
+fused ResNet bottleneck: with conv1/conv3 (1x1) riding bn_matmul and
+conv2 (3x3) riding this kernel, every normalized activation between the
+convolutions of stages 2-4 stays out of HBM.
+
+Design: at ResNet's stage-2..4 shapes a whole per-image feature map fits
+comfortably in VMEM (28x28x512 bf16 = 0.8 MB), so the grid is simply
+(N,) images x (optionally) nothing else — each program:
+
+  1. loads its image's RAW conv output X [H,W,K], normalizes + ReLUs it
+     ONCE (the prologue is shift-invariant, unlike the output tiles),
+  2. zero-pads to [H+2, W+2, K] in VMEM,
+  3. accumulates nine shifted [H*W, K] @ [K, O] matmuls — one per filter
+     tap, weights held as HWIO [3,3,K,O] — into an f32 [H*W, O] tile.
+
+The backward is the same nine taps transposed, single sweep over N with
+VMEM-resident dW [3,3,K,O] f32 and dgamma/dbeta accumulators: X and dOut
+are read once, dX written once, no dA or A tensor ever materializes.
+d(mean)/d(var) close over dgamma/dbeta exactly as in bn_matmul.
+
+Eligibility is a VMEM budget check (train holds w + dw f32 + three
+images): stage-4 training (512x512 taps) exceeds it and falls back, the
+big spatial stages 2-3 are in.  Reference counterpart: the cuDNN fused
+conv+BN epilogues (SURVEY.md §2.10), rebuilt TPU-style.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ._common import TRAIN_VMEM_BUDGET
+
+
+def _normalize(x, params, eps, act):
+    """[H,W,K] f32 normalize+act; params [4,K] f32 rows g,b,mu,var."""
+    import jax
+    import jax.numpy as jnp
+
+    g, b, mu, var = (params[i] for i in range(4))
+    inv = jax.lax.rsqrt(var + eps)
+    pre = (x.astype(jnp.float32) - mu) * (inv * g) + b
+    if act == "relu":
+        pre = jnp.maximum(pre, 0.0)
+    return pre
+
+
+def _taps(a_pad, H, W):
+    """The nine [H*W, K] shifted views of a zero-padded [H+2,W+2,K] map."""
+    K = a_pad.shape[-1]
+    return [a_pad[ky:ky + H, kx:kx + W, :].reshape(H * W, K)
+            for ky in range(3) for kx in range(3)]
+
+
+def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act):
+    import jax
+    import jax.numpy as jnp
+
+    H, W = x_ref.shape[1], x_ref.shape[2]
+    O = w_ref.shape[-1]
+    a = _normalize(x_ref[0], params_ref[...], eps, act)
+    a = a.astype(w_ref.dtype)
+    a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((H * W, O), jnp.float32)
+    for i, tap in enumerate(_taps(a_pad, H, W)):
+        ky, kx = divmod(i, 3)
+        acc += jax.lax.dot_general(
+            tap, w_ref[ky, kx], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    out_ref[0] = acc.reshape(H, W, O).astype(out_ref.dtype)
+
+
+def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
+                *, eps, act):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        dgb_ref[...] = jnp.zeros_like(dgb_ref)
+
+    H, W = x_ref.shape[1], x_ref.shape[2]
+    K = x_ref.shape[-1]
+    params = params_ref[...]
+    g, _, mu, var = (params[i] for i in range(4))
+    inv = jax.lax.rsqrt(var + eps)
+    x32 = x_ref[0].astype(jnp.float32)
+    xhat = (x32 - mu) * inv
+    pre = xhat * g + params[1]
+    a32 = jnp.maximum(pre, 0.0) if act == "relu" else pre
+    a = a32.astype(w_ref.dtype)
+    a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
+    do = do_ref[0]
+    do2 = do.reshape(H * W, -1)
+
+    # dW[ky,kx] += tap(ky,kx)^T @ dOut      (resident f32 accumulator)
+    taps = _taps(a_pad, H, W)
+    for i, tap in enumerate(taps):
+        ky, kx = divmod(i, 3)
+        dw_ref[ky, kx] += jax.lax.dot_general(
+            tap, do2.astype(w_ref.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # dA = transposed conv: pad dOut, REVERSED taps, w^T per tap
+    do_pad = jnp.pad(do, ((1, 1), (1, 1), (0, 0)))
+    dA = jnp.zeros((H * W, K), jnp.float32)
+    for ky in range(3):
+        for kx in range(3):
+            shifted = do_pad[2 - ky:2 - ky + H, 2 - kx:2 - kx + W, :]
+            dA += jax.lax.dot_general(
+                shifted.reshape(H * W, -1).astype(w_ref.dtype),
+                w_ref[ky, kx], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dA = dA.reshape(H, W, K)
+    dpre = jnp.where(pre > 0.0, dA, 0.0) if act == "relu" else dA
+    dx_ref[0] = (dpre * (g * inv)).astype(dx_ref.dtype)
+    dgb_ref[0] += jnp.sum(dpre * xhat, axis=(0, 1))
+    dgb_ref[1] += jnp.sum(dpre, axis=(0, 1))
+
+
+def eligible(N, H, W, K, O, dtype_bytes=2, train=True) -> bool:
+    """Lane-tiled channels, budgeted VMEM: weights (+f32 dW and the
+    image working set when training) must fit."""
+    if K % 128 or O % 128:
+        return False
+    w_bytes = 9 * K * O * dtype_bytes
+    imgs = (H + 2) * (W + 2) * K * dtype_bytes * 2 + H * W * O * 4
+    if not train:
+        return w_bytes + imgs <= TRAIN_VMEM_BUDGET
+    return w_bytes + 9 * K * O * 4 + imgs + H * W * O * dtype_bytes \
+        <= TRAIN_VMEM_BUDGET
+
+
+def bn_conv3x3_reference(x, gamma, beta, mean, var, w, act="relu",
+                         eps=1e-5):
+    """jnp fallback: normalize+act then lax 3x3 conv (XLA's conv path —
+    exactly the unfused semantics, for ineligible shapes / CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    sdt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    inv = 1.0 / jnp.sqrt(var.astype(sdt) + eps)
+    pre = (x.astype(sdt) - mean.astype(sdt)) * (inv * gamma.astype(sdt)) \
+        + beta.astype(sdt)
+    if act == "relu":
+        pre = jnp.maximum(pre, 0.0)
+    # lax.conv is dtype-strict (unlike dot): promote both operands so a
+    # mixed f32/f64 call (e.g. per-input f64 numeric grad checks under
+    # x64) doesn't raise
+    cdt = jnp.promote_types(x.dtype, w.dtype)
+    return jax.lax.conv_general_dilated(
+        pre.astype(cdt), w.astype(cdt), window_strides=(1, 1),
+        padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "OIHW", "NHWC")).astype(x.dtype)
+
+
+def _w_hwio(w):
+    """OIHW [O,K,3,3] -> HWIO [3,3,K,O] (the kernels' tap layout)."""
+    return w.transpose(2, 3, 1, 0)
+
+
+def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, act="relu",
+                   eps=1e-5, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    N, H, W, K = x.shape
+    O = w_hwio.shape[-1]
+    params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, act=act),
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((4, K), lambda n: (0, 0)),
+            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, H, W, O), x.dtype),
+        interpret=interpret,
+    )(x, params, w_hwio)
+
+
+def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, act="relu",
+                   eps=1e-5, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    N, H, W, K = x.shape
+    O = w_hwio.shape[-1]
+    params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+    dx, dw_f32, dgb = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, act=act),
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((4, K), lambda n: (0, 0)),
+            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+            pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+            pl.BlockSpec((2, K), lambda n: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, H, W, K), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, K, O), jnp.float32),
+            jax.ShapeDtypeStruct((2, K), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, params, w_hwio, do)
+    dgamma, dbeta = dgb[0], dgb[1]
+    inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)
+    dmean = -inv * gamma * dbeta
+    dvar = -0.5 * inv * inv * gamma * dgamma
+    return dx, dgamma, dbeta, dmean, dvar, dw_f32.astype(w_hwio.dtype)
+
+
+_TRAIN_CACHE = {}
+
+
+def make_bn_conv3x3_train(act="relu", eps=1e-5, interpret=False):
+    """custom_vjp fused bn+act+conv3x3 for training (generic_grad's
+    jax.vjp honors it).  Takes HWIO weights; memoized per config."""
+    key = (act, eps, interpret)
+    cached = _TRAIN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import jax
+
+    @jax.custom_vjp
+    def f(x, gamma, beta, mean, var, w_hwio):
+        return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, act=act,
+                              eps=eps, interpret=interpret)
+
+    def fwd(x, gamma, beta, mean, var, w_hwio):
+        return (f(x, gamma, beta, mean, var, w_hwio),
+                (x, gamma, beta, mean, var, w_hwio))
+
+    def bwd(res, do):
+        x, gamma, beta, mean, var, w_hwio = res
+        return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
+                              act=act, eps=eps, interpret=interpret)
+
+    f.defvjp(fwd, bwd)
+    _TRAIN_CACHE[key] = f
+    return f

@@ -5,16 +5,19 @@ The ResNet roofline (docs/perf_resnet50_roofline.md) showed the train step
 HBM-bound with ~12.9 GB/step of elementwise fusion writes — the BN-apply /
 ReLU / residual-add chains between convolutions, materialized because XLA
 cannot fuse elementwise producers into its convolution custom-calls.  A
-1x1 convolution, however, is a matmul, and a Pallas matmul CAN normalize
-its operand tiles on load (ops/pallas_kernels/bn_matmul.py).  This pass
-rewrites every eligible
+1x1 convolution is a matmul, and a Pallas matmul CAN normalize its
+operand tiles on load (ops/pallas_kernels/bn_matmul.py); the
+bottleneck's 3x3 middle conv gets the same treatment from a whole-image
+nine-tap kernel (ops/pallas_kernels/bn_conv.py).  This pass rewrites
+every eligible
 
     conv2d_1x1(relu(batch_norm(X)))                    # interior
     conv2d_1x1(relu(batch_norm(X) + shortcut))         # block output
+    conv2d_3x3(relu(batch_norm(X)))                    # bottleneck middle
 
-into a fused `bn_act_conv1x1` op reading the RAW conv output X plus the
-batch statistics — the normalized activation never materializes for that
-consumer.  Nothing is removed: the original bn/add/relu ops stay for any
+into fused `bn_act_conv1x1` / `bn_act_conv3x3` ops reading the RAW conv
+output X plus the batch statistics — the normalized activation never
+materializes for that consumer (50 of ResNet-50's 53 convs fuse).  Nothing is removed: the original bn/add/relu ops stay for any
 remaining consumers (XLA duplicates cheap elementwise chains into
 consumer fusions and dead-code-eliminates the rest at compile time), so
 fetches keep working and ineligible consumers are unaffected.
@@ -38,23 +41,29 @@ def _pair(v):
     return [int(v), int(v)]
 
 
-def _is_1x1_nhwc_conv(op, block) -> bool:
+def _conv_kind(op, block):
+    """'1x1' / '3x3' when this conv2d matches a fusable form, else None.
+    1x1: NHWC, pad 0, stride 1 or 2.  3x3: NHWC, pad 1, stride 1 (the
+    bottleneck middle conv; bn_conv.py's kernel contract)."""
     if op.type != "conv2d":
-        return False
+        return None
     if str(op.attrs.get("data_format", "NCHW")) != "NHWC":
-        return False
+        return None
     if int(op.attrs.get("groups", 1)) != 1:
-        return False
-    if _pair(op.attrs.get("paddings", [0, 0])) != [0, 0]:
-        return False
+        return None
     if _pair(op.attrs.get("dilations", [1, 1])) != [1, 1]:
-        return False
-    s = _pair(op.attrs.get("strides", [1, 1]))
-    if s not in ([1, 1], [2, 2]):
-        return False
+        return None
     w = block._find_var_recursive(op.inputs["Filter"][0])
-    return (w is not None and w.shape is not None
-            and tuple(w.shape[2:]) == (1, 1))
+    if w is None or w.shape is None:
+        return None
+    hw = tuple(w.shape[2:])
+    pads = _pair(op.attrs.get("paddings", [0, 0]))
+    s = _pair(op.attrs.get("strides", [1, 1]))
+    if hw == (1, 1) and pads == [0, 0] and s in ([1, 1], [2, 2]):
+        return "1x1"
+    if hw == (3, 3) and pads == [1, 1] and s == [1, 1]:
+        return "3x3"
+    return None
 
 
 def _trace_chain(t_name, producer, block):
@@ -120,7 +129,8 @@ def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
         if limit is not None and fused >= limit:
             new_ops.append(op)
             continue
-        if not _is_1x1_nhwc_conv(op, block):
+        kind = _conv_kind(op, block)
+        if kind is None:
             new_ops.append(op)
             continue
         chain = _trace_chain(op.inputs["Input"][0], producer, block)
@@ -128,6 +138,11 @@ def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
             new_ops.append(op)
             continue
         bn, act, residual = chain
+        if kind == "3x3" and residual is not None:
+            # bn_conv3x3 has no residual slot (doesn't occur in the
+            # bottleneck topology; keep the gate explicit)
+            new_ops.append(op)
+            continue
         saved_m = bn.outputs["SavedMean"][0]
         saved_v = bn.outputs["SavedVariance"][0]
         # the saved-stats vars are created stop_gradient (nothing read
@@ -145,13 +160,15 @@ def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
                "Filter": [op.inputs["Filter"][0]]}
         if residual is not None:
             ins["Residual"] = [residual]
+        fused_attrs = {"epsilon": float(bn.attrs.get("epsilon", 1e-5)),
+                       "act": act or ""}
+        if kind == "1x1":
+            fused_attrs["strides"] = _pair(op.attrs.get("strides", [1, 1]))
         fused_op = Operator(
-            block, "bn_act_conv1x1",
+            block, "bn_act_conv1x1" if kind == "1x1" else "bn_act_conv3x3",
             inputs=ins,
             outputs={"Output": [op.outputs["Output"][0]]},
-            attrs={"epsilon": float(bn.attrs.get("epsilon", 1e-5)),
-                   "act": act or "",
-                   "strides": _pair(op.attrs.get("strides", [1, 1]))})
+            attrs=fused_attrs)
         fused_op.attrs.setdefault("__uid__", block.program._take_uid())
         new_ops.append(fused_op)
         fused += 1

@@ -73,6 +73,55 @@ def test_bn_matmul_kernel_parity_interpret(act, has_r):
         assert err < 2e-5, (name, err)
 
 
+@pytest.mark.parametrize("act", ["relu", None])
+def test_bn_conv3x3_kernel_parity_interpret(act):
+    """Pallas nine-tap fwd + transposed-tap bwd (interpret mode) vs the
+    normalize+lax.conv reference, every gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import bn_conv as bc
+
+    rng = np.random.RandomState(0)
+    N, H, W, K, O = 2, 6, 6, 128, 128
+    x = jnp.asarray(rng.randn(N, H, W, K).astype(np.float32))
+    w = jnp.asarray(rng.randn(O, K, 3, 3).astype(np.float32) * 0.05)
+    g = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+    mu = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
+    var = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    wh = bc._w_hwio(w)
+
+    ref = bc.bn_conv3x3_reference(x, g, b, mu, var, w, act=act)
+    f = bc.make_bn_conv3x3_train(act=act, interpret=True)
+    out = f(x, g, b, mu, var, wh)
+    assert np.allclose(out, ref, atol=2e-4)
+
+    ct = jnp.asarray(rng.randn(N, H, W, O).astype(np.float32))
+    gr = jax.grad(lambda *a: jnp.sum(
+        bc.bn_conv3x3_reference(*a, act=act) * ct),
+        argnums=tuple(range(6)))(x, g, b, mu, var, w)
+    gk = jax.grad(lambda *a: jnp.sum(f(*a) * ct),
+                  argnums=tuple(range(6)))(x, g, b, mu, var, wh)
+    for name, a, b_ in zip(["x", "gamma", "beta", "mean", "var", "w"],
+                           gr, gk):
+        a = np.asarray(a)
+        if name == "w":
+            a = a.transpose(2, 3, 1, 0)  # OIHW grad -> HWIO layout
+        e = np.abs(a - np.asarray(b_)).max() / (np.abs(a).max() + 1e-8)
+        assert e < 2e-5, (name, e)
+
+
+def test_bn_conv3x3_eligibility_gates():
+    from paddle_tpu.ops.pallas_kernels.bn_conv import eligible
+
+    assert eligible(128, 28, 28, 128, 128)     # stage-2 middle conv
+    assert eligible(128, 14, 14, 256, 256)     # stage-3
+    assert not eligible(128, 7, 7, 512, 512)   # stage-4 train: VMEM
+    assert eligible(128, 7, 7, 512, 512, train=False)
+    assert not eligible(128, 56, 56, 64, 64)   # K not lane-tiled
+
+
 def test_bn_matmul_eligibility_gates():
     from paddle_tpu.ops.pallas_kernels.bn_matmul import eligible
 
@@ -103,6 +152,22 @@ def test_bn_act_conv1x1_grad(strides, res):
         check, output_slot="Output", max_relative_error=1e-2, eps=1e-3)
 
 
+@pytest.mark.parametrize("act", ["relu", ""])
+def test_bn_act_conv3x3_grad(act):
+    x = _r(2, 4, 4, 6, seed=15)
+    ins = {"X": x,
+           "Scale": _r(6, lo=0.5, hi=1.5, seed=16),
+           "Bias": _r(6, seed=17),
+           "SavedMean": _r(6, lo=-0.2, hi=0.2, seed=18),
+           "SavedVariance": _r(6, lo=0.5, hi=1.5, seed=19),
+           "Filter": _r(8, 6, 3, 3, lo=-0.3, hi=0.3, seed=20)}
+    OpTestHarness("bn_act_conv3x3", ins,
+                  {"epsilon": 1e-5, "act": act},
+                  out_slots=["Output"]).check_grad(
+        ["X", "Scale", "Bias", "SavedMean", "SavedVariance", "Filter"],
+        output_slot="Output", max_relative_error=1e-2, eps=1e-3)
+
+
 # ------------------------------------------------------------------ pass
 def _two_block_net(layers, dtype="float32"):
     """conv3x3 stem; bn+relu->conv1x1; bn+add(+bn)+relu->2x stride-2
@@ -119,8 +184,12 @@ def _two_block_net(layers, dtype="float32"):
                       bias_attr=False, data_format="NHWC")
     q = layers.conv2d(t, num_filters=128, filter_size=1, stride=2,
                       bias_attr=False, data_format="NHWC")
+    # 3x3 chain (bn_act_conv3x3): plain bn+relu -> 3x3 stride-1 pad-1
+    r3 = layers.conv2d(bn1, num_filters=128, filter_size=3, padding=1,
+                       bias_attr=False, data_format="NHWC")
     loss = (layers.mean(layers.elementwise_mul(p, p))
-            + layers.mean(layers.elementwise_mul(q, q)))
+            + layers.mean(layers.elementwise_mul(q, q))
+            + layers.mean(layers.elementwise_mul(r3, r3)))
     return loss
 
 
@@ -132,9 +201,10 @@ def test_pass_structure_and_skips():
     fluid.reset()
     loss = _two_block_net(layers)
     n = fuse_bn_matmul(fluid.default_main_program())
-    assert n == 3  # c2 plain chain + p and q residual chains
+    assert n == 4  # c2 plain + p/q residual chains + the 3x3 chain
     ops = [op.type for op in fluid.default_main_program().blocks[0].ops]
     assert ops.count("bn_act_conv1x1") == 3
+    assert ops.count("bn_act_conv3x3") == 1
     # residual chains carry the Residual input
     res_ops = [op for op in fluid.default_main_program().blocks[0].ops
                if op.type == "bn_act_conv1x1" and op.inputs.get("Residual")]
@@ -167,7 +237,7 @@ def test_fused_training_matches_unfused_small_scale():
         fluid.reset()
         loss = _two_block_net(layers)
         if fuse:
-            assert fuse_bn_matmul(fluid.default_main_program()) == 3
+            assert fuse_bn_matmul(fluid.default_main_program()) == 4
         fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
         exe = fluid.Executor(fluid.default_place())
         exe.run(fluid.default_startup_program())
@@ -201,7 +271,7 @@ def grads(fuse):
     fluid.reset()
     loss = _two_block_net(layers, dtype="float64")
     if fuse:
-        assert fuse_bn_matmul(fluid.default_main_program()) == 3
+        assert fuse_bn_matmul(fluid.default_main_program()) == 4
     fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
     prog = fluid.default_main_program()
     gvars = sorted(n for n in prog.blocks[0].vars if n.endswith("@GRAD")
@@ -231,7 +301,7 @@ print(json.dumps({"max_rel_err": err}))
     assert err < 1e-10, err
 
 
-def test_resnet50_builds_and_fuses_34_convs():
+def test_resnet50_builds_and_fuses_50_convs():
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
@@ -240,5 +310,8 @@ def test_resnet50_builds_and_fuses_34_convs():
                                dtype="float32", layout="NHWC", fuse_bn=True)
     n = sum(1 for op in fluid.default_main_program().blocks[0].ops
             if op.type == "bn_act_conv1x1")
-    assert n == 34
+    assert n == 34  # 1x1 sites
+    n3 = sum(1 for op in fluid.default_main_program().blocks[0].ops
+             if op.type == "bn_act_conv3x3")
+    assert n3 == 16  # every bottleneck's middle conv
     fluid.reset()

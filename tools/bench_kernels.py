@@ -166,8 +166,49 @@ def bench_bn_matmul():
           f"xla {_timeit(ref_step, x, g, b, mu, var, w):.2f} ms")
 
 
+def bench_bn_conv3x3():
+    """Fused BN+ReLU->3x3 conv vs normalize + XLA conv, fwd+bwd, on the
+    ResNet stage-3 middle-conv shape (bs64 to keep the microbench
+    quick)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import bn_conv as bc
+
+    N, H, W, K, O = 64, 14, 14, 256, 256
+    rng = np.random.RandomState(4)
+    x = jnp.asarray((rng.randn(N, H, W, K) * 0.2).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    w = jnp.asarray((rng.randn(O, K, 3, 3) * 0.05).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    g = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+    mu = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
+    var = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    assert bc.eligible(N, H, W, K, O)
+    wh = bc._w_hwio(w)
+    fused = bc.make_bn_conv3x3_train()
+
+    @jax.jit
+    def fused_step(x, g, b, mu, var, wh):
+        return jax.grad(
+            lambda *a: fused(*a).astype(jnp.float32).sum(),
+            argnums=(0, 5))(x, g, b, mu, var, wh)
+
+    @jax.jit
+    def ref_step(x, g, b, mu, var, w):
+        return jax.grad(
+            lambda *a: bc.bn_conv3x3_reference(*a)
+            .astype(jnp.float32).sum(),
+            argnums=(0, 5))(x, g, b, mu, var, w)
+
+    print(f"bn_conv3x3 train n{N} {H}x{W} k{K} o{O} bf16: "
+          f"fused {_timeit(fused_step, x, g, b, mu, var, wh):.2f} ms vs "
+          f"xla {_timeit(ref_step, x, g, b, mu, var, w):.2f} ms")
+
+
 if __name__ == "__main__":
     bench_lstm()
     bench_gru()
     bench_flash()
     bench_bn_matmul()
+    bench_bn_conv3x3()
