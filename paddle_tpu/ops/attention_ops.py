@@ -130,7 +130,9 @@ def scaled_dot_product_attention(ctx, ins, attrs):
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
     else:
         out = None
-        if ctx.target_platform() == "tpu" and mesh is None:
+        from .pallas_kernels._common import pallas_dispatch_ok
+
+        if pallas_dispatch_ok(ctx):
             # single-chip fast path: the Pallas flash kernel (VMEM-tiled
             # online softmax); training goes through the custom_vjp pair
             # (FlashAttention-2-style blockwise backward), which
@@ -138,11 +140,8 @@ def scaled_dot_product_attention(ctx, ins, attrs):
             # the XLA-fused dense path (GSPMD cannot partition the Mosaic
             # call).  Shape gates per the kernel's contract:
             # self-attention lengths, T tiles of 128, lane-width head dim.
-            from .pallas_kernels._common import kernels_enabled
-
             T, D = q.shape[2], q.shape[3]
-            if kernels_enabled() and (
-                    T % 128 == 0 and D <= 128 and k.shape[2] == T
+            if (T % 128 == 0 and D <= 128 and k.shape[2] == T
                     and v.shape[2] == T):
                 from .pallas_kernels import flash_attention as fa
 

@@ -272,10 +272,10 @@ def bn_act_conv1x1(ctx, ins, attrs):
     w2 = w.reshape(o, k).T  # [K, O]
 
     from .pallas_kernels import bn_matmul as bmm
-    from .pallas_kernels._common import kernels_enabled
+    from .pallas_kernels._common import pallas_dispatch_ok
 
     out2 = None
-    if (ctx.target_platform() == "tpu" and kernels_enabled()
+    if (pallas_dispatch_ok(ctx)
             and bmm.eligible(x2.shape[0], k, o, x2.dtype.itemsize,
                              train=not ctx.is_test)):
         f = bmm.make_bn_matmul_train(act=act, eps=eps,
@@ -310,11 +310,11 @@ def bn_act_conv3x3(ctx, ins, attrs):
     act = attrs.get("act") or None
 
     from .pallas_kernels import bn_conv as bcv
-    from .pallas_kernels._common import kernels_enabled
+    from .pallas_kernels._common import pallas_dispatch_ok
 
     n, h, ww, k = x.shape
     o = w.shape[0]
-    if (ctx.target_platform() == "tpu" and kernels_enabled()
+    if (pallas_dispatch_ok(ctx)
             and bcv.eligible(n, h, ww, k, o, x.dtype.itemsize,
                              train=not ctx.is_test)):
         f = bcv.make_bn_conv3x3_train(act=act, eps=eps)

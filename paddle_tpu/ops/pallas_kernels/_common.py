@@ -37,6 +37,16 @@ def lanes_ok(B: int, H: int) -> bool:
 _RUNTIME_DISABLED = None  # None | str reason
 
 
+def pallas_dispatch_ok(ctx) -> bool:
+    """The ONE gate every fused-kernel emitter must pass before taking a
+    Pallas path: the trace targets a real TPU, lowering is NOT sharded
+    (GSPMD cannot partition a Mosaic custom call — a ParallelExecutor
+    mesh keeps the XLA-fusable fallback), and kernels aren't disabled.
+    Centralized so a new emitter can't repeat the mesh-gate omission."""
+    return (ctx.target_platform() == "tpu" and ctx.mesh is None
+            and kernels_enabled())
+
+
 def kernels_enabled() -> bool:
     """PADDLE_TPU_NO_FUSED_KERNELS=1 forces every op back to its XLA
     fallback — the escape hatch if a fused path regresses on some

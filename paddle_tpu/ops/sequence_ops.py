@@ -254,12 +254,15 @@ def lstm(ctx, ins, attrs):
         h0 = ins["H0"][0]
     if ins.get("C0") and ins["C0"][0] is not None:
         c0 = ins["C0"][0]
-    if ctx.target_platform() == "tpu":
+    from .pallas_kernels._common import pallas_dispatch_ok as _pok
+
+    if _pok(ctx):
         # fused Pallas time-loop (VMEM-resident state and weight): forward
         # kernel at inference, forward+fused-BPTT-backward (custom_vjp —
-        # honored by the generic_grad jax.vjp) in training.  Gated on the
-        # trace's target device, not the process-global backend — an
-        # Executor(CPUPlace()) in a TPU process must not lower Pallas/TPU.
+        # honored by the generic_grad jax.vjp) in training.  Gated by the
+        # central pallas_dispatch_ok: the trace's target device (an
+        # Executor(CPUPlace()) in a TPU process must not lower Pallas/TPU)
+        # AND unsharded lowering (GSPMD cannot partition Mosaic calls).
         # is_reverse rides the same kernels through reverse-within-length
         # views of input/outputs (bidirectional nets use both directions).
         from .pallas_kernels import lstm as plstm
@@ -335,10 +338,12 @@ def gru(ctx, ins, attrs):
     B = x.shape[0]
     h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
         jnp.zeros((B, H), x.dtype)
-    if ctx.target_platform() == "tpu":
+    from .pallas_kernels._common import pallas_dispatch_ok as _pok
+
+    if _pok(ctx):
         # fused Pallas time loop (forward kernel at inference, custom_vjp
         # forward+BPTT pair in training) — see pallas_kernels/gru.py; same
-        # device gating + reverse-within-length handling as the LSTM path
+        # device/mesh gating + reverse-within-length handling as the LSTM
         from .pallas_kernels import gru as pgru
         from .pallas_kernels._common import reverse_within_length as _rev
 
