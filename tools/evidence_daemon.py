@@ -201,6 +201,44 @@ MAX_FAILURES = 3  # a capture failing this often with a HEALTHY tunnel is a
                   # timeout every cycle and stop writing duplicate artifacts
 
 
+def run_cycle(done, failures, captures=None, probe_fn=None,
+              capture_fn=None):
+    """One probe-and-capture pass; returns 'paused' | 'down' | 'partial'
+    | 'done'.  Factored out of main() so the capture sequencing — the
+    code path that only ever runs when the tunnel recovers — is testable
+    without a tunnel (tests stub probe_fn/capture_fn)."""
+    captures = CAPTURES if captures is None else captures
+    probe_fn = probe if probe_fn is None else probe_fn
+    capture_fn = run_capture if capture_fn is None else capture_fn
+    if paused():
+        log({"event": "paused"})
+        return "paused"
+    if not probe_fn():
+        return "down"
+    for name, argv, env, timeout in captures:
+        if name in done:
+            continue
+        if paused():
+            return "paused"
+        if capture_fn(name, argv, env, timeout):
+            done.add(name)
+        else:
+            if paused():
+                return "paused"
+            if not probe_fn():
+                return "down"  # tunnel died mid-capture: doesn't count
+                # against the capture
+            failures[name] = failures.get(name, 0) + 1
+            if failures[name] >= MAX_FAILURES:
+                log({"event": "capture_given_up", "name": name,
+                     "failures": failures[name]})
+                done.add(name)
+    if len(done) == len(captures):
+        log({"event": "all_captures_done"})
+        return "done"
+    return "partial"
+
+
 def main():
     os.makedirs(OUT, exist_ok=True)
     done = set()
@@ -208,32 +246,13 @@ def main():
     log({"event": "daemon_start", "pid": os.getpid(),
          "interval_s": PROBE_INTERVAL})
     while True:
-        if paused():
-            log({"event": "paused"})
+        state = run_cycle(done, failures)
+        if state == "done":
+            time.sleep(1800)  # keep heartbeat-probing, slowly
+        elif state == "paused":
             time.sleep(60)
-            continue
-        if probe():
-            for name, argv, env, timeout in CAPTURES:
-                if name in done:
-                    continue
-                if paused():
-                    break
-                if run_capture(name, argv, env, timeout):
-                    done.add(name)
-                else:
-                    if paused() or not probe():
-                        break  # stood down, or tunnel died mid-capture:
-                        # back to the loop; doesn't count against the capture
-                    failures[name] = failures.get(name, 0) + 1
-                    if failures[name] >= MAX_FAILURES:
-                        log({"event": "capture_given_up", "name": name,
-                             "failures": failures[name]})
-                        done.add(name)
-            if len(done) == len(CAPTURES):
-                log({"event": "all_captures_done"})
-                time.sleep(1800)  # keep heartbeat-probing, slowly
-                continue
-        time.sleep(PROBE_INTERVAL)
+        else:
+            time.sleep(PROBE_INTERVAL)
 
 
 if __name__ == "__main__":
