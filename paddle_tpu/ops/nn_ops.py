@@ -306,6 +306,7 @@ def bn_act_conv3x3(ctx, ins, attrs):
     scale, bias = ins["Scale"][0], ins["Bias"][0]
     mean, var = ins["SavedMean"][0], ins["SavedVariance"][0]
     w = ins["Filter"][0]      # OIHW [O, K, 3, 3]
+    res = ins["Residual"][0] if ins.get("Residual") else None
     eps = float(attrs.get("epsilon", 1e-5))
     act = attrs.get("act") or None
 
@@ -316,15 +317,18 @@ def bn_act_conv3x3(ctx, ins, attrs):
     o = w.shape[0]
     if (pallas_dispatch_ok(ctx)
             and bcv.eligible(n, h, ww, k, o, x.dtype.itemsize,
-                             train=not ctx.is_test)):
-        f = bcv.make_bn_conv3x3_train(act=act, eps=eps)
-        out = f(x, scale.astype(jnp.float32), bias.astype(jnp.float32),
+                             train=not ctx.is_test,
+                             has_residual=res is not None)):
+        f = bcv.make_bn_conv3x3_train(act=act, eps=eps,
+                                      has_residual=res is not None)
+        args = (x, scale.astype(jnp.float32), bias.astype(jnp.float32),
                 mean.astype(jnp.float32), var.astype(jnp.float32),
                 bcv._w_hwio(w))
+        out = f(*args, res) if res is not None else f(*args)
     else:
         # the reference derives its stats dtype from x and casts params
         out = bcv.bn_conv3x3_reference(x, scale, bias, mean, var, w,
-                                       act=act, eps=eps)
+                                       r=res, act=act, eps=eps)
     return {"Output": [out]}
 
 

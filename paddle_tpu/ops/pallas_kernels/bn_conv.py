@@ -53,12 +53,26 @@ def _taps(a_pad, H, W):
 
 
 def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act):
+    _fwd_body(x_ref, params_ref, w_ref, None, out_ref, eps=eps, act=act)
+
+
+def _fwd_kernel_res(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps,
+                    act):
+    _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, eps=eps, act=act)
+
+
+def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act):
     import jax
     import jax.numpy as jnp
 
     H, W = x_ref.shape[1], x_ref.shape[2]
     O = w_ref.shape[-1]
-    a = _normalize(x_ref[0], params_ref[...], eps, act)
+    a = _normalize(x_ref[0], params_ref[...], eps,
+                   None if r_ref is not None else act)
+    if r_ref is not None:
+        a = a + r_ref[0].astype(a.dtype)
+        if act == "relu":
+            a = jnp.maximum(a, 0.0)
     a = a.astype(w_ref.dtype)
     a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
     acc = jnp.zeros((H * W, O), jnp.float32)
@@ -72,6 +86,18 @@ def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act):
 
 def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
                 *, eps, act):
+    _bwd_body(x_ref, params_ref, w_ref, None, do_ref, dx_ref, dw_ref,
+              dgb_ref, None, eps=eps, act=act)
+
+
+def _bwd_kernel_res(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref,
+                    dw_ref, dgb_ref, dr_ref, *, eps, act):
+    _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
+              dgb_ref, dr_ref, eps=eps, act=act)
+
+
+def _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
+              dgb_ref, dr_ref, *, eps, act):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -91,6 +117,8 @@ def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
     x32 = x_ref[0].astype(jnp.float32)
     xhat = (x32 - mu) * inv
     pre = xhat * g + params[1]
+    if r_ref is not None:
+        pre = pre + r_ref[0].astype(jnp.float32)
     a32 = jnp.maximum(pre, 0.0) if act == "relu" else pre
     a = a32.astype(w_ref.dtype)
     a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
@@ -118,27 +146,34 @@ def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
     dA = dA.reshape(H, W, K)
     dpre = jnp.where(pre > 0.0, dA, 0.0) if act == "relu" else dA
     dx_ref[0] = (dpre * (g * inv)).astype(dx_ref.dtype)
+    if dr_ref is not None:
+        dr_ref[0] = dpre.astype(dr_ref.dtype)
     dgb_ref[0] += jnp.sum(dpre * xhat, axis=(0, 1))
     dgb_ref[1] += jnp.sum(dpre, axis=(0, 1))
 
 
-def eligible(N, H, W, K, O, dtype_bytes=2, train=True) -> bool:
+def eligible(N, H, W, K, O, dtype_bytes=2, train=True,
+             has_residual=False) -> bool:
     """Lane-tiled channels, budgeted VMEM: weights (+f32 dW and the
     image working set when training) must fit."""
     if K % 128 or O % 128:
         return False
     w_bytes = 9 * K * O * dtype_bytes
     imgs = (H + 2) * (W + 2) * K * dtype_bytes * 2 + H * W * O * 4
+    if has_residual:
+        # r input always; the dr output buffer exists only in training
+        imgs += (2 if train else 1) * H * W * K * dtype_bytes
     if not train:
         return w_bytes + imgs <= TRAIN_VMEM_BUDGET
     return w_bytes + 9 * K * O * 4 + imgs + H * W * O * dtype_bytes \
         <= TRAIN_VMEM_BUDGET
 
 
-def bn_conv3x3_reference(x, gamma, beta, mean, var, w, act="relu",
-                         eps=1e-5):
-    """jnp fallback: normalize+act then lax 3x3 conv (XLA's conv path —
-    exactly the unfused semantics, for ineligible shapes / CPU)."""
+def bn_conv3x3_reference(x, gamma, beta, mean, var, w, r=None,
+                         act="relu", eps=1e-5):
+    """jnp fallback: normalize(+residual)+act then lax 3x3 conv (XLA's
+    conv path — exactly the unfused semantics, for ineligible shapes /
+    CPU)."""
     import jax
     import jax.numpy as jnp
 
@@ -146,6 +181,8 @@ def bn_conv3x3_reference(x, gamma, beta, mean, var, w, act="relu",
     inv = 1.0 / jnp.sqrt(var.astype(sdt) + eps)
     pre = (x.astype(sdt) - mean.astype(sdt)) * (inv * gamma.astype(sdt)) \
         + beta.astype(sdt)
+    if r is not None:
+        pre = pre + r.astype(sdt)
     if act == "relu":
         pre = jnp.maximum(pre, 0.0)
     # lax.conv is dtype-strict (unlike dot): promote both operands so a
@@ -163,8 +200,8 @@ def _w_hwio(w):
     return w.transpose(2, 3, 1, 0)
 
 
-def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, act="relu",
-                   eps=1e-5, interpret=False):
+def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=None,
+                   act="relu", eps=1e-5, interpret=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -172,22 +209,30 @@ def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, act="relu",
     N, H, W, K = x.shape
     O = w_hwio.shape[-1]
     params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+    in_specs = [
+        pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+        pl.BlockSpec((4, K), lambda n: (0, 0)),
+        pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+    ]
+    args = [x, params, w_hwio]
+    if r is not None:
+        in_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
+        args.append(r)
+        kern = functools.partial(_fwd_kernel_res, eps=eps, act=act)
+    else:
+        kern = functools.partial(_fwd_kernel, eps=eps, act=act)
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps, act=act),
+        kern,
         grid=(N,),
-        in_specs=[
-            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
-            pl.BlockSpec((4, K), lambda n: (0, 0)),
-            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((N, H, W, O), x.dtype),
         interpret=interpret,
-    )(x, params, w_hwio)
+    )(*args)
 
 
-def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, act="relu",
-                   eps=1e-5, interpret=False):
+def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, r=None,
+                   act="relu", eps=1e-5, interpret=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -195,59 +240,95 @@ def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, act="relu",
     N, H, W, K = x.shape
     O = w_hwio.shape[-1]
     params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
-    dx, dw_f32, dgb = pl.pallas_call(
-        functools.partial(_bwd_kernel, eps=eps, act=act),
+    in_specs = [
+        pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+        pl.BlockSpec((4, K), lambda n: (0, 0)),
+        pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+    ]
+    args = [x, params, w_hwio]
+    if r is not None:
+        in_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
+        args.append(r)
+    in_specs.append(pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)))
+    args.append(do)
+    out_specs = [
+        pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
+        pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
+        pl.BlockSpec((2, K), lambda n: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((N, H, W, K), x.dtype),
+        jax.ShapeDtypeStruct((3, 3, K, O), jnp.float32),
+        jax.ShapeDtypeStruct((2, K), jnp.float32),
+    ]
+    if r is not None:
+        out_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((N, H, W, K), r.dtype))
+        kern = functools.partial(_bwd_kernel_res, eps=eps, act=act)
+    else:
+        kern = functools.partial(_bwd_kernel, eps=eps, act=act)
+    outs = pl.pallas_call(
+        kern,
         grid=(N,),
-        in_specs=[
-            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
-            pl.BlockSpec((4, K), lambda n: (0, 0)),
-            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
-            pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
-            pl.BlockSpec((3, 3, K, O), lambda n: (0, 0, 0, 0)),
-            pl.BlockSpec((2, K), lambda n: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((N, H, W, K), x.dtype),
-            jax.ShapeDtypeStruct((3, 3, K, O), jnp.float32),
-            jax.ShapeDtypeStruct((2, K), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
-    )(x, params, w_hwio, do)
+    )(*args)
+    dx, dw_f32, dgb = outs[0], outs[1], outs[2]
     dgamma, dbeta = dgb[0], dgb[1]
     inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)
     dmean = -inv * gamma * dbeta
     dvar = -0.5 * inv * inv * gamma * dgamma
-    return dx, dgamma, dbeta, dmean, dvar, dw_f32.astype(w_hwio.dtype)
+    dw = dw_f32.astype(w_hwio.dtype)
+    if r is not None:
+        return dx, dgamma, dbeta, dmean, dvar, dw, outs[3]
+    return dx, dgamma, dbeta, dmean, dvar, dw
 
 
 _TRAIN_CACHE = {}
 
 
-def make_bn_conv3x3_train(act="relu", eps=1e-5, interpret=False):
-    """custom_vjp fused bn+act+conv3x3 for training (generic_grad's
-    jax.vjp honors it).  Takes HWIO weights; memoized per config."""
-    key = (act, eps, interpret)
+def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
+                          interpret=False):
+    """custom_vjp fused bn(+residual)+act+conv3x3 for training
+    (generic_grad's jax.vjp honors it).  Takes HWIO weights; memoized
+    per config."""
+    key = (act, eps, has_residual, interpret)
     cached = _TRAIN_CACHE.get(key)
     if cached is not None:
         return cached
     import jax
 
-    @jax.custom_vjp
-    def f(x, gamma, beta, mean, var, w_hwio):
-        return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, act=act,
-                              eps=eps, interpret=interpret)
+    if has_residual:
+        @jax.custom_vjp
+        def f(x, gamma, beta, mean, var, w_hwio, r):
+            return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=r,
+                                  act=act, eps=eps, interpret=interpret)
 
-    def fwd(x, gamma, beta, mean, var, w_hwio):
-        return (f(x, gamma, beta, mean, var, w_hwio),
-                (x, gamma, beta, mean, var, w_hwio))
+        def fwd(x, gamma, beta, mean, var, w_hwio, r):
+            return (f(x, gamma, beta, mean, var, w_hwio, r),
+                    (x, gamma, beta, mean, var, w_hwio, r))
 
-    def bwd(res, do):
-        x, gamma, beta, mean, var, w_hwio = res
-        return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
-                              act=act, eps=eps, interpret=interpret)
+        def bwd(res, do):
+            x, gamma, beta, mean, var, w_hwio, r = res
+            return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
+                                  r=r, act=act, eps=eps,
+                                  interpret=interpret)
+    else:
+        @jax.custom_vjp
+        def f(x, gamma, beta, mean, var, w_hwio):
+            return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio,
+                                  act=act, eps=eps, interpret=interpret)
+
+        def fwd(x, gamma, beta, mean, var, w_hwio):
+            return (f(x, gamma, beta, mean, var, w_hwio),
+                    (x, gamma, beta, mean, var, w_hwio))
+
+        def bwd(res, do):
+            x, gamma, beta, mean, var, w_hwio = res
+            return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
+                                  act=act, eps=eps, interpret=interpret)
 
     f.defvjp(fwd, bwd)
     _TRAIN_CACHE[key] = f

@@ -13,7 +13,7 @@ every eligible
 
     conv2d_1x1(relu(batch_norm(X)))                    # interior
     conv2d_1x1(relu(batch_norm(X) + shortcut))         # block output
-    conv2d_3x3(relu(batch_norm(X)))                    # bottleneck middle
+    conv2d_3x3(relu(batch_norm(X)[+shortcut]))         # basicblock/middle
 
 into fused `bn_act_conv1x1` / `bn_act_conv3x3` ops reading the RAW conv
 output X plus the batch statistics — the normalized activation never
@@ -138,11 +138,6 @@ def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
             new_ops.append(op)
             continue
         bn, act, residual = chain
-        if kind == "3x3" and residual is not None:
-            # bn_conv3x3 has no residual slot (doesn't occur in the
-            # bottleneck topology; keep the gate explicit)
-            new_ops.append(op)
-            continue
         saved_m = bn.outputs["SavedMean"][0]
         saved_v = bn.outputs["SavedVariance"][0]
         # the saved-stats vars are created stop_gradient (nothing read

@@ -73,10 +73,12 @@ def test_bn_matmul_kernel_parity_interpret(act, has_r):
         assert err < 2e-5, (name, err)
 
 
-@pytest.mark.parametrize("act", ["relu", None])
-def test_bn_conv3x3_kernel_parity_interpret(act):
+@pytest.mark.parametrize("act,has_r", [("relu", False), (None, False),
+                                       ("relu", True), (None, True)])
+def test_bn_conv3x3_kernel_parity_interpret(act, has_r):
     """Pallas nine-tap fwd + transposed-tap bwd (interpret mode) vs the
-    normalize+lax.conv reference, every gradient."""
+    normalize+lax.conv reference, every gradient, with and without the
+    residual input."""
     import jax
     import jax.numpy as jnp
 
@@ -90,21 +92,32 @@ def test_bn_conv3x3_kernel_parity_interpret(act):
     b = jnp.asarray(rng.randn(K).astype(np.float32))
     mu = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
     var = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    r = jnp.asarray(rng.randn(N, H, W, K).astype(np.float32))         if has_r else None
     wh = bc._w_hwio(w)
+    args = (x, g, b, mu, var, wh) + ((r,) if has_r else ())
 
-    ref = bc.bn_conv3x3_reference(x, g, b, mu, var, w, act=act)
-    f = bc.make_bn_conv3x3_train(act=act, interpret=True)
-    out = f(x, g, b, mu, var, wh)
-    assert np.allclose(out, ref, atol=2e-4)
+    def ref(*a):
+        return bc.bn_conv3x3_reference(
+            a[0], a[1], a[2], a[3], a[4], w,
+            r=a[6] if has_r else None, act=act)
+
+    f = bc.make_bn_conv3x3_train(act=act, has_residual=has_r,
+                                 interpret=True)
+    assert np.allclose(f(*args), ref(*args), atol=2e-4)
 
     ct = jnp.asarray(rng.randn(N, H, W, O).astype(np.float32))
-    gr = jax.grad(lambda *a: jnp.sum(
-        bc.bn_conv3x3_reference(*a, act=act) * ct),
-        argnums=tuple(range(6)))(x, g, b, mu, var, w)
+    # reference grads wrt OIHW w need argnums against the ORIGINAL args
+    ref_args = (x, g, b, mu, var, w) + ((r,) if has_r else ())
+
+    def loss_ref(*a):
+        return jnp.sum(bc.bn_conv3x3_reference(
+            *a[:6], r=a[6] if has_r else None, act=act) * ct)
+
+    gr = jax.grad(loss_ref, argnums=tuple(range(len(ref_args))))(*ref_args)
     gk = jax.grad(lambda *a: jnp.sum(f(*a) * ct),
-                  argnums=tuple(range(6)))(x, g, b, mu, var, wh)
-    for name, a, b_ in zip(["x", "gamma", "beta", "mean", "var", "w"],
-                           gr, gk):
+                  argnums=tuple(range(len(args))))(*args)
+    names = ["x", "gamma", "beta", "mean", "var", "w"] +         (["r"] if has_r else [])
+    for name, a, b_ in zip(names, gr, gk):
         a = np.asarray(a)
         if name == "w":
             a = a.transpose(2, 3, 1, 0)  # OIHW grad -> HWIO layout
@@ -187,9 +200,13 @@ def _two_block_net(layers, dtype="float32"):
     # 3x3 chain (bn_act_conv3x3): plain bn+relu -> 3x3 stride-1 pad-1
     r3 = layers.conv2d(bn1, num_filters=128, filter_size=3, padding=1,
                        bias_attr=False, data_format="NHWC")
+    # 3x3 RESIDUAL chain (basicblock conv1 shape): relu(bn+short) -> 3x3
+    r4 = layers.conv2d(t, num_filters=128, filter_size=3, padding=1,
+                       bias_attr=False, data_format="NHWC")
     loss = (layers.mean(layers.elementwise_mul(p, p))
             + layers.mean(layers.elementwise_mul(q, q))
-            + layers.mean(layers.elementwise_mul(r3, r3)))
+            + layers.mean(layers.elementwise_mul(r3, r3))
+            + layers.mean(layers.elementwise_mul(r4, r4)))
     return loss
 
 
@@ -201,10 +218,13 @@ def test_pass_structure_and_skips():
     fluid.reset()
     loss = _two_block_net(layers)
     n = fuse_bn_matmul(fluid.default_main_program())
-    assert n == 4  # c2 plain + p/q residual chains + the 3x3 chain
+    assert n == 5  # c2 plain + p/q residual 1x1 + plain/residual 3x3
     ops = [op.type for op in fluid.default_main_program().blocks[0].ops]
     assert ops.count("bn_act_conv1x1") == 3
-    assert ops.count("bn_act_conv3x3") == 1
+    assert ops.count("bn_act_conv3x3") == 2
+    res3 = [op for op in fluid.default_main_program().blocks[0].ops
+            if op.type == "bn_act_conv3x3" and op.inputs.get("Residual")]
+    assert len(res3) == 1
     # residual chains carry the Residual input
     res_ops = [op for op in fluid.default_main_program().blocks[0].ops
                if op.type == "bn_act_conv1x1" and op.inputs.get("Residual")]
@@ -237,7 +257,7 @@ def test_fused_training_matches_unfused_small_scale():
         fluid.reset()
         loss = _two_block_net(layers)
         if fuse:
-            assert fuse_bn_matmul(fluid.default_main_program()) == 4
+            assert fuse_bn_matmul(fluid.default_main_program()) == 5
         fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
         exe = fluid.Executor(fluid.default_place())
         exe.run(fluid.default_startup_program())
@@ -271,7 +291,7 @@ def grads(fuse):
     fluid.reset()
     loss = _two_block_net(layers, dtype="float64")
     if fuse:
-        assert fuse_bn_matmul(fluid.default_main_program()) == 4
+        assert fuse_bn_matmul(fluid.default_main_program()) == 5
     fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
     prog = fluid.default_main_program()
     gvars = sorted(n for n in prog.blocks[0].vars if n.endswith("@GRAD")
@@ -299,6 +319,24 @@ print(json.dumps({"max_rel_err": err}))
     err = json.loads([l for l in out.stdout.splitlines()
                       if l.startswith("{")][-1])["max_rel_err"]
     assert err < 1e-10, err
+
+
+def test_resnet18_basicblocks_fuse():
+    """resnet-18 basicblocks: stride-1 conv1 rides the residual 3x3
+    chain, every conv2 the plain 3x3 chain, stage-boundary shortcuts the
+    1x1 chain."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    fluid.reset()
+    resnet.build_train_program(batch_size=2, depth=18, class_dim=10,
+                               dtype="float32", layout="NHWC", fuse_bn=True)
+    ops = [op.type for op in fluid.default_main_program().blocks[0].ops]
+    # 8 conv2 (plain) + 4 stride-1 conv1 (residual) = 12 3x3 sites;
+    # 3 stage-boundary 1x1 shortcuts
+    assert ops.count("bn_act_conv3x3") == 12
+    assert ops.count("bn_act_conv1x1") == 3
+    fluid.reset()
 
 
 def test_resnet50_builds_and_fuses_50_convs():
