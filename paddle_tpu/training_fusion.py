@@ -100,21 +100,38 @@ def _trace_chain(t_name, producer, block):
     return op, act, residual
 
 
-def fuse_bn_matmul(program=None, block_id: int = 0, limit=None) -> int:
-    """Rewrite eligible 1x1 convs to fused bn_act_conv1x1 ops in place;
-    returns how many convs were fused.  Run BEFORE optimizer.minimize so
-    the backward pass differentiates the fused graph."""
+def fuse_bn_matmul(program=None, block_id=None, limit=None) -> int:
+    """Rewrite eligible convs to fused bn_act_conv* ops in place; returns
+    how many were fused.  Run BEFORE optimizer.minimize so the backward
+    pass differentiates the fused graph.
+
+    Processes EVERY block by default (block_id=None): with remat on, the
+    residual blocks live inside recompute sub-blocks, and a block-0-only
+    pass would silently fuse nothing (jax.checkpoint recomputes through
+    the fused custom_vjp kernels just fine).  Chains never cross block
+    boundaries — a conv whose producer lives in another block simply
+    doesn't match.  `limit` applies across all blocks."""
     from .framework import core
-    from .framework.core import Operator
 
     if program is None:
         program = core.default_main_program()
-    block = program.blocks[block_id]
-    for op in block.ops:
-        if op.type.endswith("_grad") or op.type == "generic_grad":
-            raise ValueError(
-                "fuse_bn_matmul must run before append_backward/minimize "
-                f"(found {op.type!r})")
+    blocks = (program.blocks if block_id is None
+              else [program.blocks[block_id]])
+    for block in blocks:
+        for op in block.ops:
+            if op.type.endswith("_grad") or op.type == "generic_grad":
+                raise ValueError(
+                    "fuse_bn_matmul must run before append_backward/"
+                    f"minimize (found {op.type!r})")
+    total = 0
+    for block in blocks:
+        n = None if limit is None else limit - total
+        total += _fuse_block(block, n)
+    return total
+
+
+def _fuse_block(block, limit=None) -> int:
+    from .framework.core import Operator
 
     producer = {}
     for op in block.ops:

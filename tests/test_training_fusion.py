@@ -440,3 +440,49 @@ def test_pallas_dispatch_gate_unit(monkeypatch):
     with pytest.raises(RuntimeError, match="sentinel"):
         nn_ops.bn_act_conv1x1(ctx, ins, attrs)
     assert calls
+
+
+def test_fusion_reaches_recompute_sub_blocks():
+    """With remat, chains live inside recompute sub-blocks; a block-0-only
+    pass would silently fuse nothing (and the bench's remat+bnfuse A/B
+    would measure an unfused program under a fused label)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.training_fusion import fuse_bn_matmul
+
+    def build(fuse):
+        fluid.reset()
+        img = layers.data(name="image", shape=[8, 8, 128], dtype="float32")
+        with layers.recompute():
+            a = layers.conv2d(img, num_filters=128, filter_size=3,
+                              padding=1, bias_attr=False,
+                              data_format="NHWC")
+            bn1 = layers.batch_norm(a, act="relu", data_layout="NHWC")
+            c2 = layers.conv2d(bn1, num_filters=128, filter_size=1,
+                               bias_attr=False, data_format="NHWC")
+        loss = layers.mean(layers.elementwise_mul(c2, c2))
+        n = fuse_bn_matmul(fluid.default_main_program()) if fuse else 0
+        fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
+        return loss, n
+
+    loss, n = build(True)
+    prog = fluid.default_main_program()
+    fused_in_subblocks = sum(
+        1 for b in prog.blocks[1:] for op in b.ops
+        if op.type == "bn_act_conv1x1")
+    assert n == 1 and fused_in_subblocks == 1
+
+    def run(fuse):
+        loss, _ = build(fuse)
+        exe = fluid.Executor(fluid.default_place())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(7)
+        img = rng.rand(8, 8, 8, 128).astype("float32")
+        return [float(np.asarray(
+            exe.run(feed={"image": img}, fetch_list=[loss])[0]))
+            for _ in range(6)]
+
+    a, b = run(False), run(True)
+    assert a[-1] < a[0]
+    for x, y in zip(a, b):
+        assert abs(x - y) / max(abs(x), 1e-8) < 1e-4, (a, b)
