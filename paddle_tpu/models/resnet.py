@@ -156,6 +156,11 @@ def build_train_program(batch_size=64, depth=50, class_dim=1000,
 
     if fuse_bn is None:
         fuse_bn = os.environ.get("PADDLE_TPU_FUSE_BN_MM") == "1"
+    if fuse_bn and layout != "NHWC":
+        import warnings
+
+        warnings.warn("fuse_bn requested but layout is NCHW: the fusion "
+                      "pass is NHWC-only, training proceeds UNFUSED")
     if fuse_bn and layout == "NHWC":
         from ..training_fusion import fuse_bn_matmul
 

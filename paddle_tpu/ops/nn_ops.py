@@ -294,7 +294,8 @@ def bn_act_conv1x1(ctx, ins, attrs):
 
 @register_op("bn_act_conv3x3")
 def bn_act_conv3x3(ctx, ins, attrs):
-    """Fused BatchNorm+act -> 3x3 convolution (NHWC, stride 1, pad 1):
+    """Fused BatchNorm(+residual)+act -> 3x3 convolution (NHWC, stride
+    1 or 2, pad 1):
     bn_act_conv1x1's companion for the bottleneck's middle conv, backed
     by ops/pallas_kernels/bn_conv.py (whole-image VMEM tiles, nine-tap
     matmuls, single-N-sweep fused backward).  Created only by
@@ -309,18 +310,24 @@ def bn_act_conv3x3(ctx, ins, attrs):
     res = ins["Residual"][0] if ins.get("Residual") else None
     eps = float(attrs.get("epsilon", 1e-5))
     act = attrs.get("act") or None
+    strides = _pair(attrs.get("strides", [1, 1]))
+    # the kernel is square-stride only; a non-square stride (never
+    # produced by training_fusion) takes the reference path
+    stride = strides[0] if strides[0] == strides[1] else tuple(strides)
 
     from .pallas_kernels import bn_conv as bcv
     from .pallas_kernels._common import pallas_dispatch_ok
 
     n, h, ww, k = x.shape
     o = w.shape[0]
-    if (pallas_dispatch_ok(ctx)
+    if (pallas_dispatch_ok(ctx) and isinstance(stride, int)
             and bcv.eligible(n, h, ww, k, o, x.dtype.itemsize,
                              train=not ctx.is_test,
-                             has_residual=res is not None)):
+                             has_residual=res is not None,
+                             stride=stride)):
         f = bcv.make_bn_conv3x3_train(act=act, eps=eps,
-                                      has_residual=res is not None)
+                                      has_residual=res is not None,
+                                      stride=stride)
         args = (x, scale.astype(jnp.float32), bias.astype(jnp.float32),
                 mean.astype(jnp.float32), var.astype(jnp.float32),
                 bcv._w_hwio(w))
@@ -328,7 +335,8 @@ def bn_act_conv3x3(ctx, ins, attrs):
     else:
         # the reference derives its stats dtype from x and casts params
         out = bcv.bn_conv3x3_reference(x, scale, bias, mean, var, w,
-                                       r=res, act=act, eps=eps)
+                                       r=res, act=act, eps=eps,
+                                       stride=stride)
     return {"Output": [out]}
 
 

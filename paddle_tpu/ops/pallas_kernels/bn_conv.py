@@ -1,5 +1,5 @@
-"""Fused BatchNorm+ReLU -> 3x3 convolution (stride 1, pad 1, NHWC) as a
-Pallas TPU kernel — the companion of bn_matmul.py that completes the
+"""Fused BatchNorm(+residual)+ReLU -> 3x3 convolution (stride 1 or 2,
+pad 1, NHWC) as a Pallas TPU kernel — the companion of bn_matmul.py that completes the
 fused ResNet bottleneck: with conv1/conv3 (1x1) riding bn_matmul and
 conv2 (3x3) riding this kernel, every normalized activation between the
 convolutions of stages 2-4 stays out of HBM.
@@ -45,27 +45,48 @@ def _normalize(x, params, eps, act):
     return pre
 
 
-def _taps(a_pad, H, W):
-    """The nine [H*W, K] shifted views of a zero-padded [H+2,W+2,K] map."""
+def _taps(a_pad, H_out, W_out, stride=1):
+    """The nine [H_out*W_out, K] shifted (optionally strided) views of a
+    zero-padded [H+2,W+2,K] map."""
     K = a_pad.shape[-1]
-    return [a_pad[ky:ky + H, kx:kx + W, :].reshape(H * W, K)
+    return [a_pad[ky:ky + stride * H_out:stride,
+                  kx:kx + stride * W_out:stride, :].reshape(
+                      H_out * W_out, K)
             for ky in range(3) for kx in range(3)]
 
 
-def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act):
-    _fwd_body(x_ref, params_ref, w_ref, None, out_ref, eps=eps, act=act)
+def _dilate2(do):
+    """[H2,W2,O] -> [2*H2,2*W2,O] with do at even positions, zeros
+    elsewhere — the stride-2 transposed-conv dilation, built from
+    stack+reshape (no scatter: Mosaic-friendly)."""
+    import jax.numpy as jnp
+
+    H2, W2, O = do.shape
+    z = jnp.zeros_like(do)
+    rows = jnp.stack([do, z], axis=1).reshape(2 * H2, W2, O)
+    zr = jnp.zeros_like(rows)
+    return jnp.stack([rows, zr], axis=2).reshape(2 * H2, 2 * W2, O)
+
+
+def _fwd_kernel(x_ref, params_ref, w_ref, out_ref, *, eps, act,
+                stride=1):
+    _fwd_body(x_ref, params_ref, w_ref, None, out_ref, eps=eps, act=act,
+              stride=stride)
 
 
 def _fwd_kernel_res(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps,
-                    act):
-    _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, eps=eps, act=act)
+                    act, stride=1):
+    _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, eps=eps, act=act,
+              stride=stride)
 
 
-def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act):
+def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act,
+              stride=1):
     import jax
     import jax.numpy as jnp
 
     H, W = x_ref.shape[1], x_ref.shape[2]
+    Ho, Wo = H // stride, W // stride
     O = w_ref.shape[-1]
     a = _normalize(x_ref[0], params_ref[...], eps,
                    None if r_ref is not None else act)
@@ -75,29 +96,29 @@ def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act):
             a = jnp.maximum(a, 0.0)
     a = a.astype(w_ref.dtype)
     a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
-    acc = jnp.zeros((H * W, O), jnp.float32)
-    for i, tap in enumerate(_taps(a_pad, H, W)):
+    acc = jnp.zeros((Ho * Wo, O), jnp.float32)
+    for i, tap in enumerate(_taps(a_pad, Ho, Wo, stride)):
         ky, kx = divmod(i, 3)
         acc += jax.lax.dot_general(
             tap, w_ref[ky, kx], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    out_ref[0] = acc.reshape(H, W, O).astype(out_ref.dtype)
+    out_ref[0] = acc.reshape(Ho, Wo, O).astype(out_ref.dtype)
 
 
 def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
-                *, eps, act):
+                *, eps, act, stride=1):
     _bwd_body(x_ref, params_ref, w_ref, None, do_ref, dx_ref, dw_ref,
-              dgb_ref, None, eps=eps, act=act)
+              dgb_ref, None, eps=eps, act=act, stride=stride)
 
 
 def _bwd_kernel_res(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref,
-                    dw_ref, dgb_ref, dr_ref, *, eps, act):
+                    dw_ref, dgb_ref, dr_ref, *, eps, act, stride=1):
     _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
-              dgb_ref, dr_ref, eps=eps, act=act)
+              dgb_ref, dr_ref, eps=eps, act=act, stride=stride)
 
 
 def _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
-              dgb_ref, dr_ref, *, eps, act):
+              dgb_ref, dr_ref, *, eps, act, stride=1):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -110,6 +131,7 @@ def _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
         dgb_ref[...] = jnp.zeros_like(dgb_ref)
 
     H, W = x_ref.shape[1], x_ref.shape[2]
+    Ho, Wo = H // stride, W // stride
     K = x_ref.shape[-1]
     params = params_ref[...]
     g, _, mu, var = (params[i] for i in range(4))
@@ -123,18 +145,20 @@ def _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
     a = a32.astype(w_ref.dtype)
     a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
     do = do_ref[0]
-    do2 = do.reshape(H * W, -1)
+    do2 = do.reshape(Ho * Wo, -1)
 
     # dW[ky,kx] += tap(ky,kx)^T @ dOut      (resident f32 accumulator)
-    taps = _taps(a_pad, H, W)
+    taps = _taps(a_pad, Ho, Wo, stride)
     for i, tap in enumerate(taps):
         ky, kx = divmod(i, 3)
         dw_ref[ky, kx] += jax.lax.dot_general(
             tap, do2.astype(w_ref.dtype), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    # dA = transposed conv: pad dOut, REVERSED taps, w^T per tap
-    do_pad = jnp.pad(do, ((1, 1), (1, 1), (0, 0)))
+    # dA = transposed conv: (stride-2: dilate dOut first — even grid
+    # positions hold dO, zeros elsewhere) pad, REVERSED taps, w^T per tap
+    do_t = do if stride == 1 else _dilate2(do)
+    do_pad = jnp.pad(do_t, ((1, 1), (1, 1), (0, 0)))
     dA = jnp.zeros((H * W, K), jnp.float32)
     for ky in range(3):
         for kx in range(3):
@@ -153,10 +177,14 @@ def _bwd_body(x_ref, params_ref, w_ref, r_ref, do_ref, dx_ref, dw_ref,
 
 
 def eligible(N, H, W, K, O, dtype_bytes=2, train=True,
-             has_residual=False) -> bool:
+             has_residual=False, stride=1) -> bool:
     """Lane-tiled channels, budgeted VMEM: weights (+f32 dW and the
     image working set when training) must fit."""
     if K % 128 or O % 128:
+        return False
+    if stride not in (1, 2):
+        return False  # the backward dilation is built for stride 2 only
+    if stride == 2 and (H % 2 or W % 2):
         return False
     w_bytes = 9 * K * O * dtype_bytes
     imgs = (H + 2) * (W + 2) * K * dtype_bytes * 2 + H * W * O * 4
@@ -170,10 +198,11 @@ def eligible(N, H, W, K, O, dtype_bytes=2, train=True,
 
 
 def bn_conv3x3_reference(x, gamma, beta, mean, var, w, r=None,
-                         act="relu", eps=1e-5):
+                         act="relu", eps=1e-5, stride=1):
     """jnp fallback: normalize(+residual)+act then lax 3x3 conv (XLA's
     conv path — exactly the unfused semantics, for ineligible shapes /
-    CPU)."""
+    CPU).  stride may be an int or an (sh, sw) pair (the non-square case
+    only ever reaches this reference path)."""
     import jax
     import jax.numpy as jnp
 
@@ -189,8 +218,9 @@ def bn_conv3x3_reference(x, gamma, beta, mean, var, w, r=None,
     # mixed f32/f64 call (e.g. per-input f64 numeric grad checks under
     # x64) doesn't raise
     cdt = jnp.promote_types(x.dtype, w.dtype)
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
     return jax.lax.conv_general_dilated(
-        pre.astype(cdt), w.astype(cdt), window_strides=(1, 1),
+        pre.astype(cdt), w.astype(cdt), window_strides=(sh, sw),
         padding=((1, 1), (1, 1)),
         dimension_numbers=("NHWC", "OIHW", "NHWC")).astype(x.dtype)
 
@@ -201,12 +231,13 @@ def _w_hwio(w):
 
 
 def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=None,
-                   act="relu", eps=1e-5, interpret=False):
+                   act="relu", eps=1e-5, stride=1, interpret=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     N, H, W, K = x.shape
+    Ho, Wo = H // stride, W // stride
     O = w_hwio.shape[-1]
     params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
     in_specs = [
@@ -218,26 +249,29 @@ def bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=None,
     if r is not None:
         in_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
         args.append(r)
-        kern = functools.partial(_fwd_kernel_res, eps=eps, act=act)
+        kern = functools.partial(_fwd_kernel_res, eps=eps, act=act,
+                                 stride=stride)
     else:
-        kern = functools.partial(_fwd_kernel, eps=eps, act=act)
+        kern = functools.partial(_fwd_kernel, eps=eps, act=act,
+                                 stride=stride)
     return pl.pallas_call(
         kern,
         grid=(N,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((N, H, W, O), x.dtype),
+        out_specs=pl.BlockSpec((1, Ho, Wo, O), lambda n: (n, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, O), x.dtype),
         interpret=interpret,
     )(*args)
 
 
 def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, r=None,
-                   act="relu", eps=1e-5, interpret=False):
+                   act="relu", eps=1e-5, stride=1, interpret=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     N, H, W, K = x.shape
+    Ho, Wo = H // stride, W // stride
     O = w_hwio.shape[-1]
     params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
     in_specs = [
@@ -249,7 +283,7 @@ def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, r=None,
     if r is not None:
         in_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
         args.append(r)
-    in_specs.append(pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)))
+    in_specs.append(pl.BlockSpec((1, Ho, Wo, O), lambda n: (n, 0, 0, 0)))
     args.append(do)
     out_specs = [
         pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)),
@@ -264,9 +298,11 @@ def bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do, r=None,
     if r is not None:
         out_specs.append(pl.BlockSpec((1, H, W, K), lambda n: (n, 0, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((N, H, W, K), r.dtype))
-        kern = functools.partial(_bwd_kernel_res, eps=eps, act=act)
+        kern = functools.partial(_bwd_kernel_res, eps=eps, act=act,
+                                 stride=stride)
     else:
-        kern = functools.partial(_bwd_kernel, eps=eps, act=act)
+        kern = functools.partial(_bwd_kernel, eps=eps, act=act,
+                                 stride=stride)
     outs = pl.pallas_call(
         kern,
         grid=(N,),
@@ -290,11 +326,11 @@ _TRAIN_CACHE = {}
 
 
 def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
-                          interpret=False):
+                          stride=1, interpret=False):
     """custom_vjp fused bn(+residual)+act+conv3x3 for training
     (generic_grad's jax.vjp honors it).  Takes HWIO weights; memoized
     per config."""
-    key = (act, eps, has_residual, interpret)
+    key = (act, eps, has_residual, stride, interpret)
     cached = _TRAIN_CACHE.get(key)
     if cached is not None:
         return cached
@@ -304,7 +340,8 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
         @jax.custom_vjp
         def f(x, gamma, beta, mean, var, w_hwio, r):
             return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=r,
-                                  act=act, eps=eps, interpret=interpret)
+                                  act=act, eps=eps, stride=stride,
+                                  interpret=interpret)
 
         def fwd(x, gamma, beta, mean, var, w_hwio, r):
             return (f(x, gamma, beta, mean, var, w_hwio, r),
@@ -313,13 +350,14 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
         def bwd(res, do):
             x, gamma, beta, mean, var, w_hwio, r = res
             return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
-                                  r=r, act=act, eps=eps,
+                                  r=r, act=act, eps=eps, stride=stride,
                                   interpret=interpret)
     else:
         @jax.custom_vjp
         def f(x, gamma, beta, mean, var, w_hwio):
             return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio,
-                                  act=act, eps=eps, interpret=interpret)
+                                  act=act, eps=eps, stride=stride,
+                                  interpret=interpret)
 
         def fwd(x, gamma, beta, mean, var, w_hwio):
             return (f(x, gamma, beta, mean, var, w_hwio),
@@ -328,7 +366,8 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
         def bwd(res, do):
             x, gamma, beta, mean, var, w_hwio = res
             return bn_conv3x3_bwd(x, gamma, beta, mean, var, w_hwio, do,
-                                  act=act, eps=eps, interpret=interpret)
+                                  act=act, eps=eps, stride=stride,
+                                  interpret=interpret)
 
     f.defvjp(fwd, bwd)
     _TRAIN_CACHE[key] = f

@@ -43,8 +43,9 @@ def _pair(v):
 
 def _conv_kind(op, block):
     """'1x1' / '3x3' when this conv2d matches a fusable form, else None.
-    1x1: NHWC, pad 0, stride 1 or 2.  3x3: NHWC, pad 1, stride 1 (the
-    bottleneck middle conv; bn_conv.py's kernel contract)."""
+    1x1: NHWC, pad 0, stride 1 or 2.  3x3: NHWC, pad 1, stride 1 or 2
+    (bottleneck middle conv / basicblock convs; bn_conv.py's kernel
+    contract)."""
     if op.type != "conv2d":
         return None
     if str(op.attrs.get("data_format", "NCHW")) != "NHWC":
@@ -61,7 +62,7 @@ def _conv_kind(op, block):
     s = _pair(op.attrs.get("strides", [1, 1]))
     if hw == (1, 1) and pads == [0, 0] and s in ([1, 1], [2, 2]):
         return "1x1"
-    if hw == (3, 3) and pads == [1, 1] and s == [1, 1]:
+    if hw == (3, 3) and pads == [1, 1] and s in ([1, 1], [2, 2]):
         return "3x3"
     return None
 
@@ -173,9 +174,8 @@ def _fuse_block(block, limit=None) -> int:
         if residual is not None:
             ins["Residual"] = [residual]
         fused_attrs = {"epsilon": float(bn.attrs.get("epsilon", 1e-5)),
-                       "act": act or ""}
-        if kind == "1x1":
-            fused_attrs["strides"] = _pair(op.attrs.get("strides", [1, 1]))
+                       "act": act or "",
+                       "strides": _pair(op.attrs.get("strides", [1, 1]))}
         fused_op = Operator(
             block, "bn_act_conv1x1" if kind == "1x1" else "bn_act_conv3x3",
             inputs=ins,

@@ -73,9 +73,11 @@ def test_bn_matmul_kernel_parity_interpret(act, has_r):
         assert err < 2e-5, (name, err)
 
 
-@pytest.mark.parametrize("act,has_r", [("relu", False), (None, False),
-                                       ("relu", True), (None, True)])
-def test_bn_conv3x3_kernel_parity_interpret(act, has_r):
+@pytest.mark.parametrize("act,has_r,stride",
+                         [("relu", False, 1), (None, False, 1),
+                          ("relu", True, 1), (None, True, 1),
+                          ("relu", False, 2), ("relu", True, 2)])
+def test_bn_conv3x3_kernel_parity_interpret(act, has_r, stride):
     """Pallas nine-tap fwd + transposed-tap bwd (interpret mode) vs the
     normalize+lax.conv reference, every gradient, with and without the
     residual input."""
@@ -99,19 +101,21 @@ def test_bn_conv3x3_kernel_parity_interpret(act, has_r):
     def ref(*a):
         return bc.bn_conv3x3_reference(
             a[0], a[1], a[2], a[3], a[4], w,
-            r=a[6] if has_r else None, act=act)
+            r=a[6] if has_r else None, act=act, stride=stride)
 
     f = bc.make_bn_conv3x3_train(act=act, has_residual=has_r,
-                                 interpret=True)
+                                 stride=stride, interpret=True)
     assert np.allclose(f(*args), ref(*args), atol=2e-4)
 
-    ct = jnp.asarray(rng.randn(N, H, W, O).astype(np.float32))
+    ct = jnp.asarray(
+        rng.randn(N, H // stride, W // stride, O).astype(np.float32))
     # reference grads wrt OIHW w need argnums against the ORIGINAL args
     ref_args = (x, g, b, mu, var, w) + ((r,) if has_r else ())
 
     def loss_ref(*a):
         return jnp.sum(bc.bn_conv3x3_reference(
-            *a[:6], r=a[6] if has_r else None, act=act) * ct)
+            *a[:6], r=a[6] if has_r else None, act=act,
+            stride=stride) * ct)
 
     gr = jax.grad(loss_ref, argnums=tuple(range(len(ref_args))))(*ref_args)
     gk = jax.grad(lambda *a: jnp.sum(f(*a) * ct),
@@ -175,7 +179,8 @@ def test_bn_act_conv3x3_grad(act):
            "SavedVariance": _r(6, lo=0.5, hi=1.5, seed=19),
            "Filter": _r(8, 6, 3, 3, lo=-0.3, hi=0.3, seed=20)}
     OpTestHarness("bn_act_conv3x3", ins,
-                  {"epsilon": 1e-5, "act": act},
+                  {"epsilon": 1e-5, "act": act, "strides": [2, 2]}
+                  if act == "relu" else {"epsilon": 1e-5, "act": act},
                   out_slots=["Output"]).check_grad(
         ["X", "Scale", "Bias", "SavedMean", "SavedVariance", "Filter"],
         output_slot="Output", max_relative_error=1e-2, eps=1e-3)
@@ -322,9 +327,9 @@ print(json.dumps({"max_rel_err": err}))
 
 
 def test_resnet18_basicblocks_fuse():
-    """resnet-18 basicblocks: stride-1 conv1 rides the residual 3x3
-    chain, every conv2 the plain 3x3 chain, stage-boundary shortcuts the
-    1x1 chain."""
+    """resnet-18 basicblocks: every conv1 (stride 1 AND the stride-2
+    boundary ones) rides the residual 3x3 chain, every conv2 the plain
+    3x3 chain, stage-boundary shortcuts the 1x1 chain."""
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
@@ -332,9 +337,9 @@ def test_resnet18_basicblocks_fuse():
     resnet.build_train_program(batch_size=2, depth=18, class_dim=10,
                                dtype="float32", layout="NHWC", fuse_bn=True)
     ops = [op.type for op in fluid.default_main_program().blocks[0].ops]
-    # 8 conv2 (plain) + 4 stride-1 conv1 (residual) = 12 3x3 sites;
-    # 3 stage-boundary 1x1 shortcuts
-    assert ops.count("bn_act_conv3x3") == 12
+    # 8 conv2 (plain) + 4 stride-1 conv1 (residual) + 3 stride-2
+    # boundary conv1 (residual) = 15 3x3 sites; 3 boundary 1x1 shortcuts
+    assert ops.count("bn_act_conv3x3") == 15
     assert ops.count("bn_act_conv1x1") == 3
     fluid.reset()
 

@@ -34,6 +34,10 @@ def main():
                          "footprint from the timing)")
     ap.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"],
                     help="activation layout (bench.py headline default NHWC)")
+    ap.add_argument("--remat", action="store_true",
+                    help="checkpoint residual blocks (bench default ON)")
+    ap.add_argument("--fuse-bn", action="store_true",
+                    help="BN->conv prologue fusion (training_fusion)")
     args = ap.parse_args()
 
     import jax
@@ -45,7 +49,8 @@ def main():
 
     avg_cost, acc = resnet.build_train_program(
         batch_size=args.bs, depth=args.depth, dtype=args.dtype,
-        layout=args.layout)
+        layout=args.layout, remat=args.remat,
+        fuse_bn=args.fuse_bn)
     place = fluid.default_place()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
