@@ -491,3 +491,37 @@ def test_fusion_reaches_recompute_sub_blocks():
     assert a[-1] < a[0]
     for x, y in zip(a, b):
         assert abs(x - y) / max(abs(x), 1e-8) < 1e-4, (a, b)
+
+
+def test_fused_program_still_serves_intermediate_fetches():
+    """The pass removes nothing: a user fetching the normalized
+    activation (or the bn output) still gets the exact original values
+    even though the fused convs no longer read them."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.training_fusion import fuse_bn_matmul
+
+    def run(fuse):
+        fluid.reset()
+        img = layers.data(name="image", shape=[8, 8, 128], dtype="float32")
+        a = layers.conv2d(img, num_filters=128, filter_size=3, padding=1,
+                          bias_attr=False, data_format="NHWC")
+        bn1 = layers.batch_norm(a, act="relu", data_layout="NHWC")
+        c2 = layers.conv2d(bn1, num_filters=128, filter_size=1,
+                           bias_attr=False, data_format="NHWC")
+        loss = layers.mean(layers.elementwise_mul(c2, c2))
+        if fuse:
+            assert fuse_bn_matmul(fluid.default_main_program()) == 1
+        fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.default_place())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(7)
+        img_v = rng.rand(4, 8, 8, 128).astype("float32")
+        vals = exe.run(feed={"image": img_v},
+                       fetch_list=[loss, bn1])  # bn1: the eliminated chain
+        return [np.asarray(v) for v in vals]
+
+    base, fused = run(False), run(True)
+    np.testing.assert_allclose(fused[0], base[0], rtol=1e-5)
+    np.testing.assert_allclose(fused[1], base[1], rtol=1e-5)
+    assert np.abs(np.asarray(fused[1])).max() > 0  # real values, not zeros
