@@ -148,8 +148,12 @@ def run_capture(name, argv, env_extra, timeout):
     else:
         body["rc"] = p.returncode
         ok = bool(results)
-        if not ok:
-            body["stderr_tail"] = (stderr or "").strip()[-1500:]
+    if not ok:
+        # human-readable output (e.g. partial microbench rows printed
+        # before a hang or crash) is evidence too — keep the tails for
+        # interrupted AND failed captures alike
+        body["stderr_tail"] = (stderr or "").strip()[-1500:]
+        body["stdout_tail"] = (stdout or "").strip()[-1500:]
     with open(path, "w") as f:
         json.dump(body, f, indent=1)
     log({"event": "capture_done", "name": name, "ok": ok, "path": path,
