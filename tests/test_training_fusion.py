@@ -525,3 +525,51 @@ def test_fused_program_still_serves_intermediate_fetches():
     np.testing.assert_allclose(fused[0], base[0], rtol=1e-5)
     np.testing.assert_allclose(fused[1], base[1], rtol=1e-5)
     assert np.abs(np.asarray(fused[1])).max() > 0  # real values, not zeros
+
+
+def test_fused_program_saves_loads_and_infers_identically(tmp_path):
+    """save_inference_model prunes a FUSED training program down to the
+    fused inference graph (bn_act_conv* ops serialize through the desc
+    proto), and the loaded model's test-mode semantics — fused ops read
+    SavedMean/SavedVariance, which a test-mode batch_norm sets to the
+    RUNNING stats — match the unfused model exactly."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.training_fusion import fuse_bn_matmul
+
+    def build(fuse):
+        fluid.reset()
+        img = layers.data(name="image", shape=[8, 8, 128], dtype="float32")
+        a = layers.conv2d(img, num_filters=128, filter_size=3, padding=1,
+                          bias_attr=False, data_format="NHWC")
+        bn1 = layers.batch_norm(a, act="relu", data_layout="NHWC")
+        c2 = layers.conv2d(bn1, num_filters=128, filter_size=1,
+                           bias_attr=False, data_format="NHWC")
+        bn2 = layers.batch_norm(c2, act=None, data_layout="NHWC")
+        t = layers.elementwise_add(x=bn1, y=bn2, act="relu")
+        out = layers.conv2d(t, num_filters=128, filter_size=3, padding=1,
+                            bias_attr=False, data_format="NHWC")
+        loss = layers.mean(layers.elementwise_mul(out, out))
+        if fuse:
+            assert fuse_bn_matmul(fluid.default_main_program()) == 2
+        fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
+        return out
+
+    ys = {}
+    for fuse in (False, True):
+        out = build(fuse)
+        exe = fluid.Executor(fluid.default_place())
+        exe.run(fluid.default_startup_program())  # same deterministic init
+        rng = np.random.RandomState(3)
+        img_v = rng.rand(4, 8, 8, 128).astype("float32")
+        d = str(tmp_path / f"model_{fuse}")
+        fluid.io.save_inference_model(
+            d, ["image"], [out], exe,
+            main_program=fluid.default_main_program())
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        fused_kinds = {op.type for op in prog2.blocks[0].ops}
+        if fuse:
+            assert {"bn_act_conv1x1", "bn_act_conv3x3"} <= fused_kinds
+        (y2,) = exe.run(prog2, feed={"image": img_v}, fetch_list=fetches)
+        ys[fuse] = np.asarray(y2)
+    np.testing.assert_allclose(ys[True], ys[False], rtol=1e-5)
