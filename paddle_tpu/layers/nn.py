@@ -290,7 +290,8 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 
 def multi_head_attention(queries, keys, values, num_heads, causal=False,
-                         param_attr=None, name=None, sp_mode="ring"):
+                         param_attr=None, name=None, sp_mode="ring",
+                         sp_schedule="plain"):
     """Transformer multi-head attention over [B, T, D] (beyond-reference:
     the 2018 reference's closest construct is v1 simple_attention).  QKV and
     output projections are fc ops (MXU GEMMs); the core runs
@@ -300,6 +301,10 @@ def multi_head_attention(queries, keys, values, num_heads, causal=False,
     helper = LayerHelper("multi_head_attention", name=name)
     if sp_mode not in ("ring", "alltoall"):
         raise ValueError(f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")
+    if sp_schedule not in ("plain", "zigzag"):
+        raise ValueError(
+            f"sp_schedule {sp_schedule!r}: use 'plain' or 'zigzag' "
+            "(zigzag = load-balanced causal flash ring, inference)")
     D = queries.shape[-1]
     assert D % num_heads == 0, "hidden size must divide num_heads"
     q = fc(queries, D, num_flatten_dims=2, param_attr=param_attr,
@@ -326,7 +331,8 @@ def multi_head_attention(queries, keys, values, num_heads, causal=False,
         "scaled_dot_product_attention",
         inputs={"Q": [qh.name], "K": [kh.name], "V": [vh.name]},
         outputs={"Out": [attn.name]},
-        attrs={"causal": causal, "sp_mode": sp_mode},
+        attrs={"causal": causal, "sp_mode": sp_mode,
+               "sp_schedule": sp_schedule},
     )
     back = helper.create_tmp_variable(queries.dtype)
     helper.append_op("transpose", inputs={"X": [attn.name]},

@@ -122,9 +122,18 @@ def scaled_dot_product_attention(ctx, ins, attrs):
         elif sp_mode == "ring":
             fl = on_tpu and ra.flash_ring_eligible(
                 q, mesh, "sp", causal=causal, is_train=not ctx.is_test)
+            # zigzag (load-balanced causal schedule) holds a stricter
+            # contract: causal flash INFERENCE with 2S-divisible tiles;
+            # anything else falls back to the plain schedule
+            sched = str(attrs.get("sp_schedule", "plain"))
+            if sched == "zigzag":
+                t2 = q.shape[2] // (2 * axis_size(mesh, "sp"))
+                if not (fl and causal and ctx.is_test and t2 % 128 == 0):
+                    sched = "plain"
             out = ra.ring_attention(q, k, v, mesh, axis_name="sp",
                                     causal=causal, use_flash=fl,
-                                    is_train=not ctx.is_test)
+                                    is_train=not ctx.is_test,
+                                    schedule=sched)
         else:
             raise ValueError(
                 f"sp_mode {sp_mode!r}: use 'ring' or 'alltoall'")

@@ -123,8 +123,9 @@ def _ring_flash_fwd(q, k, v, axis_name: str, S: int, scale: float,
                  + out_s.astype(jnp.float32)
                  * jnp.exp(lse_s - lse_new)[..., None])
         lse_acc = lse_new
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if s < S - 1:  # the final hop's result would be discarded
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
     return o_acc.astype(q.dtype), lse_acc
 
 
@@ -162,12 +163,111 @@ def _ring_flash_bwd(q, k, v, out, lse, do, axis_name: str, S: int,
         dq_acc = dq_acc + dq_s.astype(jnp.float32)
         dk_acc = dk_acc + dk_s.astype(jnp.float32)
         dv_acc = dv_acc + dv_s.astype(jnp.float32)
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if s < S - 1:  # k/v's final hop would be discarded...
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        # ...but the GRAD accumulators need all S hops to arrive home
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
     return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
             dv_acc.astype(v.dtype))
+
+
+def zigzag_permutation(T: int, S: int):
+    """Permutation placing chunk pair (d, 2S-1-d) contiguous for device d
+    (T split into 2S half-chunks).  Returns (perm, inv) index arrays:
+    x_zig = x[..., perm, :] shards the zigzag layout contiguously;
+    x = x_zig[..., inv, :] undoes it."""
+    import numpy as np
+
+    t2 = T // (2 * S)
+    order = []
+    for d in range(S):
+        order.extend(range(d * t2, (d + 1) * t2))
+        order.extend(range((2 * S - 1 - d) * t2, (2 * S - d) * t2))
+    perm = np.asarray(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return perm, inv
+
+
+def _ring_flash_zigzag_fwd(q, k, v, axis_name: str, S: int, scale: float,
+                           interpret: bool):
+    """Load-balanced CAUSAL flash ring over the zigzag layout: device d
+    holds half-chunks (d, 2S-1-d) of 2S.  The causal block structure
+    collapses to selects, never conditionals or discarded work:
+
+      - (q_early, kv_late_visiting): ALWAYS fully masked — never computed
+      - (q_late,  kv_early_visiting): ALWAYS fully attended — one full
+        block per step
+      - exactly ONE of (q_early, kv_early) / (q_late, kv_late) is live
+        per step (s <= d vs s > d): computed as a single full block on
+        where-SELECTED operands, accumulated into the matching chunk
+      - step 0 adds the two causal diagonals
+
+    Every device therefore does 2S+1 equal-size blocks — the ~2x causal
+    utilization fix over the compute-and-mask schedule (which runs S full
+    steps but discards the future half on average)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.pallas_kernels import flash_attention as fa
+
+    my = lax.axis_index(axis_name)
+    B, H, t2x2, D = q.shape
+    t2 = t2x2 // 2
+    qe, ql = q[:, :, :t2], q[:, :, t2:]
+    kv = jnp.stack([k, v])  # rotate as one buffer
+
+    def merge(o_acc, lse_acc, o_s, lse_s):
+        lse_s = lse_s.reshape(lse_acc.shape).astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse_acc, lse_s)
+        o_new = (o_acc * jnp.exp(lse_acc - lse_new)[..., None]
+                 + o_s.astype(jnp.float32)
+                 * jnp.exp(lse_s - lse_new)[..., None])
+        return o_new, lse_new
+
+    def block(qc, kc, vc, causal):
+        out, lse = fa.flash_attention_fwd(qc, kc, vc, causal=causal,
+                                          scale=scale, interpret=interpret)
+        return out, lse
+
+    acc = {
+        "e": (jnp.zeros(qe.shape, jnp.float32),
+              jnp.full(qe.shape[:-1], -jnp.inf, jnp.float32)),
+        "l": (jnp.zeros(ql.shape, jnp.float32),
+              jnp.full(ql.shape[:-1], -jnp.inf, jnp.float32)),
+    }
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    kv_cur = kv
+    for s in range(S):
+        ke, ve = kv_cur[0, :, :, :t2], kv_cur[1, :, :, :t2]
+        kl, vl = kv_cur[0, :, :, t2:], kv_cur[1, :, :, t2:]
+        if s == 0:
+            o, l_ = block(qe, ke, ve, causal=True)   # early diagonal
+            acc["e"] = merge(*acc["e"], o, l_)
+            o, l_ = block(ql, kl, vl, causal=True)   # late diagonal
+            acc["l"] = merge(*acc["l"], o, l_)
+        else:
+            # one live early-vs-early OR late-vs-late block, selected
+            take_e = my >= s  # early pair live iff no ring wrap yet
+            q_sel = jnp.where(take_e, qe, ql)
+            k_sel = jnp.where(take_e, ke, kl)
+            v_sel = jnp.where(take_e, ve, vl)
+            o, l_ = block(q_sel, k_sel, v_sel, causal=False)
+            l_ = l_.reshape(acc["e"][1].shape)
+            oe, le = merge(*acc["e"], o,
+                           jnp.where(take_e, l_, -jnp.inf))
+            ol, ll = merge(*acc["l"], o,
+                           jnp.where(take_e, -jnp.inf, l_))
+            acc["e"], acc["l"] = (oe, le), (ol, ll)
+        # late queries always attend the visiting early chunk fully
+        o, l_ = block(ql, ke, ve, causal=False)
+        acc["l"] = merge(*acc["l"], o, l_)
+        if s < S - 1:  # the final hop's result would be discarded
+            kv_cur = lax.ppermute(kv_cur, axis_name, perm)
+    out = jnp.concatenate([acc["e"][0], acc["l"][0]], axis=2)
+    return out.astype(q.dtype)
 
 
 _RING_TRAIN_CACHE = {}
@@ -230,13 +330,22 @@ def flash_ring_eligible(q, mesh, axis_name: str, causal: bool,
 def ring_attention(q, k, v, mesh, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
                    use_flash: bool = False, is_train: bool = False,
+                   schedule: str = "plain", pre_permuted: bool = False,
                    interpret: bool = False):
     """q,k,v [B,H,T,D] (T divisible by mesh['sp']) → [B,H,T,D], computed with
     the sequence axis sharded over `axis_name`.  `use_flash=True` (gate
     with flash_ring_eligible) runs each per-chunk attention as a Pallas
     flash kernel and merges chunks by logsumexp — including causal (per-
     step static schedule) and training (`is_train=True`: the ring-level
-    custom_vjp whose backward rotates dk/dv with their chunks)."""
+    custom_vjp whose backward rotates dk/dv with their chunks).
+
+    `schedule="zigzag"` (causal flash inference) runs the load-balanced
+    zigzag schedule: inputs are permuted so each device holds one early +
+    one late half-chunk, making per-device work equal (2S+1 blocks) where
+    the plain causal ring discards half its compute on average.  The
+    in/out permutations are global gathers (a reshard each) — amortize
+    them across a multi-layer stack by permuting activations ONCE with
+    `zigzag_permutation` and passing `pre_permuted=True` per layer."""
     import jax
 
     from .mesh import get_shard_map
@@ -247,10 +356,33 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(None, None, axis_name, None)
+    zigzag = schedule == "zigzag"
+    if zigzag and not (use_flash and causal and not is_train):
+        raise ValueError(
+            "schedule='zigzag' currently supports causal flash "
+            "inference (use_flash=True, causal=True, is_train=False)")
     if use_flash:
         from .mesh import axis_size
 
         S = axis_size(mesh, axis_name)
+        if zigzag:
+            import jax.numpy as jnp
+
+            T = q.shape[2]
+            if T % (2 * S):
+                raise ValueError(
+                    f"zigzag needs T divisible by 2*S ({T} vs {2 * S})")
+            body = functools.partial(_ring_flash_zigzag_fwd,
+                                     axis_name=axis_name, S=S, scale=s,
+                                     interpret=interpret)
+            fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+            if pre_permuted:  # caller laid out zigzag once for the stack
+                return fn(q, k, v)
+            perm, inv = zigzag_permutation(T, S)
+            out = fn(jnp.take(q, perm, axis=2), jnp.take(k, perm, axis=2),
+                     jnp.take(v, perm, axis=2))
+            return jnp.take(out, inv, axis=2)
         if is_train:
             body = make_ring_flash_train(axis_name, S, causal, s,
                                          interpret=interpret)

@@ -253,3 +253,43 @@ def test_ring_flash_causal_train_matches_dense(causal):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    atol=2e-3, rtol=2e-3,
                                    err_msg=f"d{name}")
+
+
+def test_zigzag_causal_ring_matches_dense():
+    """Load-balanced zigzag causal flash ring (every device computes the
+    same 2S+1 full-size blocks; no discarded work) vs dense causal."""
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=512, D=32)
+    dense = attention(q, k, v, causal=True)
+    zig = ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                         schedule="zigzag", interpret=True)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(dense),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_zigzag_contract_errors():
+    import jax
+
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=512, D=32)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, k, v, mesh, causal=False, use_flash=True,
+                       schedule="zigzag", interpret=True)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                       is_train=True, schedule="zigzag", interpret=True)
+    bad_t, _, _ = _qkv(B=1, H=2, T=258, D=32)  # 258 % (2*2) != 0
+    with pytest.raises(ValueError, match="divisible"):
+        ring_attention(bad_t, bad_t, bad_t, mesh, causal=True,
+                       use_flash=True, schedule="zigzag", interpret=True)
+
+
+def test_zigzag_permutation_roundtrip():
+    from paddle_tpu.parallel.ring_attention import zigzag_permutation
+
+    perm, inv = zigzag_permutation(16, 2)
+    x = np.arange(16)
+    assert (x[perm][inv] == x).all()
+    # device 0's contiguous block = chunks 0 and 3; device 1's = 1 and 2
+    assert list(perm[:8]) == [0, 1, 2, 3, 12, 13, 14, 15]
+    assert list(perm[8:]) == [4, 5, 6, 7, 8, 9, 10, 11]
