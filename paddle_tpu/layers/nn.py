@@ -304,7 +304,7 @@ def multi_head_attention(queries, keys, values, num_heads, causal=False,
     if sp_schedule not in ("plain", "zigzag"):
         raise ValueError(
             f"sp_schedule {sp_schedule!r}: use 'plain' or 'zigzag' "
-            "(zigzag = load-balanced causal flash ring, inference)")
+            "(zigzag = load-balanced causal flash ring, fwd and bwd)")
     D = queries.shape[-1]
     assert D % num_heads == 0, "hidden size must divide num_heads"
     q = fc(queries, D, num_flatten_dims=2, param_attr=param_attr,

@@ -122,13 +122,13 @@ def scaled_dot_product_attention(ctx, ins, attrs):
         elif sp_mode == "ring":
             fl = on_tpu and ra.flash_ring_eligible(
                 q, mesh, "sp", causal=causal, is_train=not ctx.is_test)
-            # zigzag (load-balanced causal schedule) holds a stricter
-            # contract: causal flash INFERENCE with 2S-divisible tiles;
+            # zigzag (load-balanced causal schedule, fwd AND bwd) holds
+            # a stricter contract: causal flash with 2S-divisible tiles;
             # anything else falls back to the plain schedule
             sched = str(attrs.get("sp_schedule", "plain"))
             if sched == "zigzag":
                 t2 = q.shape[2] // (2 * axis_size(mesh, "sp"))
-                if not (fl and causal and ctx.is_test and t2 % 128 == 0):
+                if not (fl and causal and t2 % 128 == 0):
                     sched = "plain"
             out = ra.ring_attention(q, k, v, mesh, axis_name="sp",
                                     causal=causal, use_flash=fl,

@@ -206,8 +206,13 @@ def _ring_flash_zigzag_fwd(q, k, v, axis_name: str, S: int, scale: float,
       - step 0 adds the two causal diagonals
 
     Every device therefore does 2S+1 equal-size blocks — the ~2x causal
-    utilization fix over the compute-and-mask schedule (which runs S full
-    steps but discards the future half on average)."""
+    utilization fix over the compute-and-mask schedule.  Inference entry;
+    training rides make_ring_flash_zigzag_train over the same core."""
+    return _ring_flash_zigzag_core(q, k, v, axis_name, S, scale,
+                                   interpret)[0]
+
+
+def _ring_flash_zigzag_core(q, k, v, axis_name, S, scale, interpret):
     import jax.numpy as jnp
     from jax import lax
 
@@ -217,7 +222,7 @@ def _ring_flash_zigzag_fwd(q, k, v, axis_name: str, S: int, scale: float,
     B, H, t2x2, D = q.shape
     t2 = t2x2 // 2
     qe, ql = q[:, :, :t2], q[:, :, t2:]
-    kv = jnp.stack([k, v])  # rotate as one buffer
+    kv = jnp.stack([k, v])
 
     def merge(o_acc, lse_acc, o_s, lse_s):
         lse_s = lse_s.reshape(lse_acc.shape).astype(jnp.float32)
@@ -226,11 +231,6 @@ def _ring_flash_zigzag_fwd(q, k, v, axis_name: str, S: int, scale: float,
                  + o_s.astype(jnp.float32)
                  * jnp.exp(lse_s - lse_new)[..., None])
         return o_new, lse_new
-
-    def block(qc, kc, vc, causal):
-        out, lse = fa.flash_attention_fwd(qc, kc, vc, causal=causal,
-                                          scale=scale, interpret=interpret)
-        return out, lse
 
     acc = {
         "e": (jnp.zeros(qe.shape, jnp.float32),
@@ -244,30 +244,148 @@ def _ring_flash_zigzag_fwd(q, k, v, axis_name: str, S: int, scale: float,
         ke, ve = kv_cur[0, :, :, :t2], kv_cur[1, :, :, :t2]
         kl, vl = kv_cur[0, :, :, t2:], kv_cur[1, :, :, t2:]
         if s == 0:
-            o, l_ = block(qe, ke, ve, causal=True)   # early diagonal
+            o, l_ = fa.flash_attention_fwd(qe, ke, ve, causal=True,
+                                           scale=scale, interpret=interpret)
             acc["e"] = merge(*acc["e"], o, l_)
-            o, l_ = block(ql, kl, vl, causal=True)   # late diagonal
+            o, l_ = fa.flash_attention_fwd(ql, kl, vl, causal=True,
+                                           scale=scale, interpret=interpret)
             acc["l"] = merge(*acc["l"], o, l_)
         else:
-            # one live early-vs-early OR late-vs-late block, selected
-            take_e = my >= s  # early pair live iff no ring wrap yet
+            take_e = my >= s
             q_sel = jnp.where(take_e, qe, ql)
             k_sel = jnp.where(take_e, ke, kl)
             v_sel = jnp.where(take_e, ve, vl)
-            o, l_ = block(q_sel, k_sel, v_sel, causal=False)
+            o, l_ = fa.flash_attention_fwd(q_sel, k_sel, v_sel,
+                                           causal=False, scale=scale,
+                                           interpret=interpret)
             l_ = l_.reshape(acc["e"][1].shape)
-            oe, le = merge(*acc["e"], o,
-                           jnp.where(take_e, l_, -jnp.inf))
-            ol, ll = merge(*acc["l"], o,
-                           jnp.where(take_e, -jnp.inf, l_))
-            acc["e"], acc["l"] = (oe, le), (ol, ll)
-        # late queries always attend the visiting early chunk fully
-        o, l_ = block(ql, ke, ve, causal=False)
+            acc["e"] = merge(*acc["e"], o,
+                             jnp.where(take_e, l_, -jnp.inf))
+            acc["l"] = merge(*acc["l"], o,
+                             jnp.where(take_e, -jnp.inf, l_))
+        o, l_ = fa.flash_attention_fwd(ql, ke, ve, causal=False,
+                                       scale=scale, interpret=interpret)
         acc["l"] = merge(*acc["l"], o, l_)
-        if s < S - 1:  # the final hop's result would be discarded
+        if s < S - 1:
             kv_cur = lax.ppermute(kv_cur, axis_name, perm)
     out = jnp.concatenate([acc["e"][0], acc["l"][0]], axis=2)
-    return out.astype(q.dtype)
+    lse = jnp.concatenate([acc["e"][1], acc["l"][1]], axis=2)
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_zigzag_bwd(q, k, v, out, lse, do, axis_name, S, scale,
+                           interpret):
+    """Zigzag backward: the SAME balanced block schedule transposed.  The
+    dk/dv accumulator pair rotates with its kv pair (all S hops, arriving
+    home); each block's blockwise flash backward runs against the global
+    per-chunk logsumexp so per-block p = exp(s - lse_tot) is the exact
+    global softmax probability.  The selected block's grads scatter into
+    the early/late halves via the same take_e selects as the forward."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.pallas_kernels import flash_attention as fa
+
+    my = lax.axis_index(axis_name)
+    B, H, t2x2, D = q.shape
+    t2 = t2x2 // 2
+    qe, ql = q[:, :, :t2], q[:, :, t2:]
+    oe, ol = out[:, :, :t2], out[:, :, t2:]
+    doe, dol = do[:, :, :t2], do[:, :, t2:]
+    lse_e = lse[:, :, :t2].reshape(B * H, t2)
+    lse_l = lse[:, :, t2:].reshape(B * H, t2)
+    kv_cur = jnp.stack([k, v])
+    dq_e = jnp.zeros(qe.shape, jnp.float32)
+    dq_l = jnp.zeros(ql.shape, jnp.float32)
+    dkv_acc = jnp.zeros((2,) + k.shape, jnp.float32)  # rotates with kv
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def bwd_block(qc, kc, vc, oc, lsec, doc, causal):
+        return fa.flash_attention_bwd(qc, kc, vc, oc, lsec, doc,
+                                      causal=causal, scale=scale,
+                                      interpret=interpret)
+
+    for s in range(S):
+        ke, ve = kv_cur[0, :, :, :t2], kv_cur[1, :, :, :t2]
+        kl, vl = kv_cur[0, :, :, t2:], kv_cur[1, :, :, t2:]
+        dke = jnp.zeros(ke.shape, jnp.float32)
+        dve = jnp.zeros(ve.shape, jnp.float32)
+        dkl = jnp.zeros(kl.shape, jnp.float32)
+        dvl = jnp.zeros(vl.shape, jnp.float32)
+        if s == 0:
+            dq_s, dk_s, dv_s = bwd_block(qe, ke, ve, oe, lse_e, doe, True)
+            dq_e += dq_s.astype(jnp.float32)
+            dke += dk_s.astype(jnp.float32)
+            dve += dv_s.astype(jnp.float32)
+            dq_s, dk_s, dv_s = bwd_block(ql, kl, vl, ol, lse_l, dol, True)
+            dq_l += dq_s.astype(jnp.float32)
+            dkl += dk_s.astype(jnp.float32)
+            dvl += dv_s.astype(jnp.float32)
+        else:
+            take_e = my >= s
+            q_sel = jnp.where(take_e, qe, ql)
+            k_sel = jnp.where(take_e, ke, kl)
+            v_sel = jnp.where(take_e, ve, vl)
+            o_sel = jnp.where(take_e, oe, ol)
+            do_sel = jnp.where(take_e, doe, dol)
+            lse_sel = jnp.where(take_e, lse_e, lse_l)
+            dq_s, dk_s, dv_s = bwd_block(q_sel, k_sel, v_sel, o_sel,
+                                         lse_sel, do_sel, False)
+            dq_e += jnp.where(take_e, dq_s, 0).astype(jnp.float32)
+            dq_l += jnp.where(take_e, 0, dq_s).astype(jnp.float32)
+            dke += jnp.where(take_e, dk_s, 0).astype(jnp.float32)
+            dve += jnp.where(take_e, dv_s, 0).astype(jnp.float32)
+            dkl += jnp.where(take_e, 0, dk_s).astype(jnp.float32)
+            dvl += jnp.where(take_e, 0, dv_s).astype(jnp.float32)
+        dq_s, dk_s, dv_s = bwd_block(ql, ke, ve, ol, lse_l, dol, False)
+        dq_l += dq_s.astype(jnp.float32)
+        dke += dk_s.astype(jnp.float32)
+        dve += dv_s.astype(jnp.float32)
+        step = jnp.stack([jnp.concatenate([dke, dkl], axis=2),
+                          jnp.concatenate([dve, dvl], axis=2)])
+        dkv_acc = dkv_acc + step
+        if s < S - 1:
+            kv_cur = lax.ppermute(kv_cur, axis_name, perm)
+        dkv_acc = lax.ppermute(dkv_acc, axis_name, perm)
+    dq = jnp.concatenate([dq_e, dq_l], axis=2)
+    return (dq.astype(q.dtype), dkv_acc[0].astype(k.dtype),
+            dkv_acc[1].astype(v.dtype))
+
+
+_ZIGZAG_TRAIN_CACHE = {}
+
+
+def make_ring_flash_zigzag_train(axis_name: str, S: int, scale: float,
+                                 interpret: bool = False):
+    """Ring-level custom_vjp for the BALANCED causal schedule: training
+    does 2S+1 equal blocks per device in fwd AND bwd (vs the plain
+    schedule's compute-and-discard).  Operates on zigzag-laid-out shards
+    (see zigzag_permutation); memoized per config."""
+    key = (axis_name, S, scale, interpret)
+    cached = _ZIGZAG_TRAIN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import jax
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        out, _ = _ring_flash_zigzag_core(q, k, v, axis_name, S, scale,
+                                         interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_flash_zigzag_core(q, k, v, axis_name, S, scale,
+                                           interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _ring_flash_zigzag_bwd(q, k, v, out, lse, do, axis_name,
+                                      S, scale, interpret)
+
+    f.defvjp(fwd, bwd)
+    _ZIGZAG_TRAIN_CACHE[key] = f
+    return f
 
 
 _RING_TRAIN_CACHE = {}
@@ -339,10 +457,11 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     step static schedule) and training (`is_train=True`: the ring-level
     custom_vjp whose backward rotates dk/dv with their chunks).
 
-    `schedule="zigzag"` (causal flash inference) runs the load-balanced
-    zigzag schedule: inputs are permuted so each device holds one early +
-    one late half-chunk, making per-device work equal (2S+1 blocks) where
-    the plain causal ring discards half its compute on average.  The
+    `schedule="zigzag"` (causal flash, inference AND training) runs the
+    load-balanced zigzag schedule: inputs are permuted so each device
+    holds one early + one late half-chunk, making per-device work equal
+    (2S+1 blocks, fwd and bwd) where the plain causal ring discards half
+    its compute on average.  The
     in/out permutations are global gathers (a reshard each) — amortize
     them across a multi-layer stack by permuting activations ONCE with
     `zigzag_permutation` and passing `pre_permuted=True` per layer."""
@@ -357,10 +476,10 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(None, None, axis_name, None)
     zigzag = schedule == "zigzag"
-    if zigzag and not (use_flash and causal and not is_train):
+    if zigzag and not (use_flash and causal):
         raise ValueError(
-            "schedule='zigzag' currently supports causal flash "
-            "inference (use_flash=True, causal=True, is_train=False)")
+            "schedule='zigzag' supports causal flash attention "
+            "(use_flash=True, causal=True)")
     if use_flash:
         from .mesh import axis_size
 
@@ -372,9 +491,13 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
             if T % (2 * S):
                 raise ValueError(
                     f"zigzag needs T divisible by 2*S ({T} vs {2 * S})")
-            body = functools.partial(_ring_flash_zigzag_fwd,
-                                     axis_name=axis_name, S=S, scale=s,
-                                     interpret=interpret)
+            if is_train:
+                body = make_ring_flash_zigzag_train(axis_name, S, s,
+                                                    interpret=interpret)
+            else:
+                body = functools.partial(_ring_flash_zigzag_fwd,
+                                         axis_name=axis_name, S=S,
+                                         scale=s, interpret=interpret)
             fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
             if pre_permuted:  # caller laid out zigzag once for the stack

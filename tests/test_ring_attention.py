@@ -267,6 +267,33 @@ def test_zigzag_causal_ring_matches_dense():
                                atol=2e-4, rtol=2e-4)
 
 
+def test_zigzag_training_grads_match_dense():
+    """The balanced schedule's custom_vjp: dq accumulates through the
+    same selects, dk/dv pair-accumulators rotate home with their kv pair
+    — gradient parity vs dense causal."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=512, D=32)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    def loss_zig(q, k, v):
+        return jnp.sum(ring_attention(
+            q, k, v, mesh, causal=True, use_flash=True, is_train=True,
+            schedule="zigzag", interpret=True) ** 2)
+
+    assert np.allclose(loss_zig(q, k, v), loss_dense(q, k, v), rtol=2e-4)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gz = jax.grad(loss_zig, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gd, gz):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name}")
+
+
 def test_zigzag_contract_errors():
     import jax
 
@@ -275,9 +302,6 @@ def test_zigzag_contract_errors():
     with pytest.raises(ValueError, match="zigzag"):
         ring_attention(q, k, v, mesh, causal=False, use_flash=True,
                        schedule="zigzag", interpret=True)
-    with pytest.raises(ValueError, match="zigzag"):
-        ring_attention(q, k, v, mesh, causal=True, use_flash=True,
-                       is_train=True, schedule="zigzag", interpret=True)
     bad_t, _, _ = _qkv(B=1, H=2, T=258, D=32)  # 258 % (2*2) != 0
     with pytest.raises(ValueError, match="divisible"):
         ring_attention(bad_t, bad_t, bad_t, mesh, causal=True,
