@@ -491,6 +491,15 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
             if T % (2 * S):
                 raise ValueError(
                     f"zigzag needs T divisible by 2*S ({T} vs {2 * S})")
+            t2 = T // (2 * S)
+            if t2 > 128 and t2 % 128:
+                # the flash kernel tiles at 128 (or one whole block for
+                # short chunks); fail here with a readable contract error
+                # rather than deep inside the pallas wrapper
+                raise ValueError(
+                    f"zigzag half-chunks of {t2} steps break the flash "
+                    f"kernel's 128-tile contract (T={T}, S={S}): use T "
+                    f"with T/(2S) a multiple of 128, or <= 128")
             if is_train:
                 body = make_ring_flash_zigzag_train(axis_name, S, s,
                                                     interpret=interpret)

@@ -308,6 +308,24 @@ def test_zigzag_contract_errors():
                        use_flash=True, schedule="zigzag", interpret=True)
 
 
+def test_zigzag_pre_permuted_path():
+    """A layer stack can amortize the layout gathers: permute once with
+    zigzag_permutation, run with pre_permuted=True, invert once."""
+    from paddle_tpu.parallel.ring_attention import zigzag_permutation
+
+    mesh = make_mesh({"sp": 2})
+    q, k, v = _qkv(B=1, H=2, T=512, D=32)
+    perm, inv = zigzag_permutation(512, 2)
+    zq, zk, zv = (np.take(a, perm, axis=2) for a in (q, k, v))
+    out = ring_attention(zq, zk, zv, mesh, causal=True, use_flash=True,
+                         schedule="zigzag", pre_permuted=True,
+                         interpret=True)
+    out = np.take(np.asarray(out), inv, axis=2)
+    dense = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, np.asarray(dense), atol=2e-4,
+                               rtol=2e-4)
+
+
 def test_zigzag_permutation_roundtrip():
     from paddle_tpu.parallel.ring_attention import zigzag_permutation
 
