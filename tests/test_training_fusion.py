@@ -573,3 +573,53 @@ def test_fused_program_saves_loads_and_infers_identically(tmp_path):
         (y2,) = exe.run(prog2, feed={"image": img_v}, fetch_list=fetches)
         ys[fuse] = np.asarray(y2)
     np.testing.assert_allclose(ys[True], ys[False], rtol=1e-5)
+
+
+def test_mosaic_failure_in_fused_bn_falls_back(monkeypatch):
+    """First on-chip contact protection for the fused BN convs: a Mosaic
+    failure from either bn kernel must degrade the FUSED training program
+    to the XLA reference path with a warning (executor runtime fallback),
+    not hard-fail it — this is the path the evidence daemon's
+    ab_resnet_bnfuse capture exercises the moment the tunnel recovers."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.ops import registry as reg
+    from paddle_tpu.ops.pallas_kernels import _common
+    from paddle_tpu.ops.pallas_kernels import bn_conv as bcv
+    from paddle_tpu.ops.pallas_kernels import bn_matmul as bmm
+    from paddle_tpu.training_fusion import fuse_bn_matmul
+
+    monkeypatch.setattr(reg.EmitContext, "target_platform",
+                        lambda self: "tpu")
+
+    def boom(**kw):
+        def f(*a, **k):
+            raise RuntimeError(
+                "Mosaic failed to lower: INTERNAL: unsupported layout")
+        return f
+
+    monkeypatch.setattr(bmm, "make_bn_matmul_train", boom)
+    monkeypatch.setattr(bcv, "make_bn_conv3x3_train", boom)
+    _common.runtime_enable()
+    try:
+        fluid.reset()
+        img = layers.data(name="image", shape=[8, 8, 128], dtype="float32")
+        a = layers.conv2d(img, num_filters=128, filter_size=3, padding=1,
+                          bias_attr=False, data_format="NHWC")
+        bn1 = layers.batch_norm(a, act="relu", data_layout="NHWC")
+        c2 = layers.conv2d(bn1, num_filters=128, filter_size=1,
+                           bias_attr=False, data_format="NHWC")
+        loss = layers.mean(layers.elementwise_mul(c2, c2))
+        assert fuse_bn_matmul(fluid.default_main_program()) == 1
+        fluid.optimizer.SGD(learning_rate=1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(7)
+        feed = {"image": rng.rand(4, 8, 8, 128).astype("float32")}
+        with pytest.warns(UserWarning, match="falling back to the XLA"):
+            (l0,) = exe.run(feed=feed, fetch_list=[loss])
+        (l1,) = exe.run(feed=feed, fetch_list=[loss])
+        assert (float(np.asarray(l1).reshape(()))
+                < float(np.asarray(l0).reshape(())))
+    finally:
+        _common.runtime_enable()
