@@ -150,12 +150,18 @@ def bench_resnet_train(warmup, iters, layout=None):
     bs = int(os.environ.get("BENCH_BS", "128"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    # per-residual-block rematerialization (VERDICT r2 Weak #3: 12.9 GB of
-    # the 53.8 GB/step is stored fusion writes; the step is HBM-bound with
-    # 4.5x compute headroom) — BENCH_REMAT=0 opts out
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    # BN->1x1-conv prologue fusion (training_fusion.py): opt-in until the
-    # on-chip A/B (evidence daemon ab_resnet_bnfuse) decides the default
+    # per-residual-block rematerialization: the r3 roofline argued for it
+    # statically, but the on-chip A/B measured it a 37% LOSS (2269.7 img/s
+    # plain vs 1427.5 remat, BENCH_attempts_r04/ab_resnet_noremat) — at
+    # bs128 the step fits HBM without checkpointing, so remat only re-does
+    # FLOPs.  Default OFF from measurement; BENCH_REMAT=1 opts in (the
+    # memory lever is still real for bigger models/batches).
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # BN->conv prologue fusion (training_fusion.py): measured on-chip at
+    # 963 img/s (3.6% MFU) vs 2269 unfused — the hand kernels LOSE to
+    # XLA's own BN+conv fusion on the v5e (BENCH_attempts_r04/
+    # ab_resnet_bnfuse*).  Stays opt-in; the pass+kernels remain for
+    # shapes XLA fuses poorly and as the Pallas fusion reference.
     fuse_bn = os.environ.get("BENCH_FUSE_BN", "0") == "1"
     if layout is None:
         layout = _env_layout()

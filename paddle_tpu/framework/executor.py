@@ -182,6 +182,24 @@ class Executor:
         # FLAGS_check_nan_inf analog: per-step non-finite scan of outputs
         self.check_nan_inf = False
 
+    def _pin_host_array(self, scope, name, v):
+        """Promote a host (numpy) scope value to a device buffer ONCE,
+        writing it back so later steps reuse the buffer.
+
+        Anything that writes numpy into the scope (fuse_batch_norm's folded
+        filters, parameters.set_value, load paths) would otherwise be
+        re-staged to the device on EVERY run — over a tunneled PJRT
+        backend that is ~100 MB of weight upload per inference batch, a
+        ~80x throughput loss observed on the bs16 ResNet-50 infer bench."""
+        if not isinstance(v, np.ndarray):
+            return v
+        import jax
+
+        dv = jax.device_put(
+            v, self.place.jax_device() if self._pin_device else None)
+        scope.set(name, dv)
+        return dv
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -224,7 +242,7 @@ class Executor:
                     f"variable {n!r} used before initialization — run the "
                     f"startup program first (fluid semantics)"
                 )
-            state_w[n] = v
+            state_w[n] = self._pin_host_array(scope, n, v)
         state_r = {}
         for n in compiled.external_reads:
             v = scope.find(n)
@@ -235,7 +253,7 @@ class Executor:
                         f"data variable {n!r} was not fed — add it to `feed`"
                     )
                 raise RuntimeError(f"variable {n!r} not initialized in scope")
-            state_r[n] = v
+            state_r[n] = self._pin_host_array(scope, n, v)
 
         rng = jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed), self._step
@@ -278,8 +296,10 @@ class Executor:
             compiled = self._compile(program, block_id, feed_vals,
                                      fetch_names)
             self._cache[key] = (load_sig, compiled)
-            state_w = {n: scope.find(n) for n in compiled.rw_state}
-            state_r = {n: scope.find(n) for n in compiled.external_reads}
+            state_w = {n: self._pin_host_array(scope, n, scope.find(n))
+                       for n in compiled.rw_state}
+            state_r = {n: self._pin_host_array(scope, n, scope.find(n))
+                       for n in compiled.external_reads}
             fetches, new_state = invoke(compiled)
         for n, v in new_state.items():
             scope.set(n, v)

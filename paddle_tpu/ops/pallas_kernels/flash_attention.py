@@ -145,7 +145,12 @@ def _kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int,
     nblk_eff = ((qi + 1) * bq + bk - 1) // bk if causal else nblk
     m, l, o = jax.lax.fori_loop(0, nblk_eff, body, (m0, l0, o0))
     o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # lse rides a (1, 1, T) full-row block: Mosaic's tile contract wants
+    # the last two block dims (8,128)-divisible OR equal to the array's —
+    # a (1, bq) block over a (BH, T) array satisfies neither (first real
+    # Mosaic compile, r4 kernels microbench).  The row block stays VMEM-
+    # resident across the i-steps of one b, each writing its bq slice.
+    lse_ref[0, 0, pl.ds(qi * bq, bq)] = m + jnp.log(l)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -157,8 +162,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]  # consumed at v.dtype by the dp GEMM — no f32 staging
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    # lse/delta arrive as (1, 1, T) full-row blocks (Mosaic tile contract,
+    # see _kernel_lse); slice this program's bq rows out in VMEM
+    lse = lse_ref[0, 0, pl.ds(qi * bq, bq)]
+    delta = delta_ref[0, 0, pl.ds(qi * bq, bq)]
     T = k_ref.shape[1]
     D = q.shape[-1]
     nblk = T // bk
@@ -210,8 +217,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * bq, bq), :]
         do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
@@ -266,15 +273,17 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            # full-row lse block, revisited across the i grid dim (Mosaic
+            # tile contract: (1, bq) blocks over a 2-D array are invalid)
+            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, D), lse
+    return out.reshape(B, H, T, D), lse.reshape(B * H, T)
 
 
 def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
@@ -291,6 +300,9 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
                            for a in (q, k, v, o, do))
     delta = jnp.sum(of.astype(jnp.float32) * dof.astype(jnp.float32),
                     axis=-1)  # [BH, T]
+    # (BH, 1, T) full-row layout for lse/delta: see _kernel_lse
+    lse3 = lse.reshape(B * H, 1, T).astype(jnp.float32)
+    delta3 = delta.reshape(B * H, 1, T)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bk=bk, scale=s, causal=causal,
@@ -301,13 +313,13 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, scale=s, causal=causal,
@@ -318,8 +330,8 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, T), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
@@ -330,7 +342,7 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
             jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta3)
     rs = lambda a: a.reshape(B, H, T, D)
     return rs(dq), rs(dk), rs(dv)
 
