@@ -182,6 +182,40 @@ class Executor:
         # FLAGS_check_nan_inf analog: per-step non-finite scan of outputs
         self.check_nan_inf = False
 
+    def optimized_hlo(self, program=None, feed=None, fetch_list=None,
+                      scope=None, block_id: int = 0) -> str:
+        """Post-optimization HLO text of the step executable.
+
+        Works on remote-compile backends where --xla_dump_to never writes
+        local files (the analysis tools' need); the recompile hits jax's
+        persistent compile cache when the program already ran.  Keeps the
+        jit argument-tuple contract inside this file instead of tools
+        reaching into _cache/_prepare_feeds (ADVICE-style: private layout
+        changes must not silently break the roofline tooling)."""
+        import jax
+
+        from .core import default_main_program
+        from .scope import global_scope as _gs
+
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else _gs()
+        feed = feed or {}
+        fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
+        block = program.blocks[block_id]
+        feed_vals = self._prepare_feeds(block, feed)
+        key = self._cache_key(program, block_id, feed_vals, fetch_names)
+        entry = self._cache.get(key)
+        if entry is None:
+            compiled = self._compile(program, block_id, feed_vals,
+                                     fetch_names)
+        else:
+            compiled = entry[1]
+        state_w = {n: scope.find(n) for n in compiled.rw_state}
+        state_r = {n: scope.find(n) for n in compiled.external_reads}
+        return compiled.fn.lower(
+            state_w, state_r, feed_vals, jax.random.PRNGKey(0)
+        ).compile().as_text()
+
     def _pin_host_array(self, scope, name, v):
         """Promote a host (numpy) scope value to a device buffer ONCE,
         writing it back so later steps reuse the buffer.

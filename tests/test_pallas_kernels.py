@@ -23,10 +23,25 @@ def test_flash_attention_matches_dense(causal):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_attention_block_not_dividing_raises():
-    q = np.zeros((1, 1, 60, 16), np.float32)
-    with pytest.raises(AssertionError):
-        flash_attention(q, q, q, block_q=16, block_k=16, interpret=True)
+def test_flash_attention_snaps_non_dividing_blocks():
+    """Block sizes are hints: a T the requested block doesn't divide snaps
+    down to a divisor instead of asserting (r4 review: the 512/1024
+    defaults must not reject seq len 1536)."""
+    from paddle_tpu.ops.pallas_kernels.flash_attention import _snap_block
+
+    assert _snap_block(512, 1536) == 512
+    assert _snap_block(1024, 1536) == 768
+    assert _snap_block(16, 60) == 15
+    rng = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 96, 16
+    q = rng.randn(B, H, T, D).astype(np.float32)
+    k = rng.randn(B, H, T, D).astype(np.float32)
+    v = rng.randn(B, H, T, D).astype(np.float32)
+    dense = attention(q, k, v, causal=True)
+    flash = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                            interpret=True)  # 64 does not divide 96 -> 48
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_pallas_lstm_matches_scan_reference():

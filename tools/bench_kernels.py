@@ -21,17 +21,27 @@ ROWS = []  # row dicts ({kernel, shape, *_ms, speedup} or {kernel, error,
 # traceback}) — the end-of-run JSON summary
 
 
-def _timeit(f, *args, iters=20):
+def _force(out):
+    """Completion barrier that cannot be faked: fetch one element of every
+    leaf.  Observed r4 on the tunneled backend: a degraded session had
+    block_until_ready RETURN EARLY (8k matmul 'measured' at 200x device
+    peak); a device->host value read is the only wait the transport must
+    honor."""
     import jax
 
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(leaf.ravel()[0] if hasattr(leaf, "ravel") else leaf)
+
+
+def _timeit(f, *args, iters=20):
     f(*args)  # compile
     for _ in range(3):
         out = f(*args)
-    jax.block_until_ready(out)
+    _force(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = f(*args)
-    jax.block_until_ready(out)
+    _force(out)
     return (time.perf_counter() - t0) / iters * 1e3
 
 
@@ -144,6 +154,48 @@ def bench_flash():
          _timeit(dense_step, q, k, v), "dense")
 
 
+def bench_flash_long():
+    """The long-context point flash exists for: at T=16k the dense path's
+    [T,T] scores (16 GB in f32 per head-batch) exceed the chip — dense
+    fails to compile, flash trains.  Record flash's time and dense's
+    failure as the row."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+    from paddle_tpu.parallel.ring_attention import attention as dense
+
+    B, H, T, D = 1, 16, 16384, 64
+    rng = np.random.RandomState(3)
+    mk = lambda: jnp.asarray(
+        (rng.randn(B, H, T, D) * 0.2).astype(np.float32), dtype=jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    fused = fa.make_flash_train(causal=True)
+
+    @jax.jit
+    def fused_step(q, k, v):
+        return jax.grad(lambda *a: fused(*a).astype(jnp.float32).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+
+    fused_ms = _timeit(fused_step, q, k, v, iters=5)
+    row = {"kernel": "flash_train_long", "shape": f"b{B} h{H} T{T} d{D} bf16",
+           "fused_ms": round(fused_ms, 2)}
+    try:
+        @jax.jit
+        def dense_step(q, k, v):
+            return jax.grad(
+                lambda *a: dense(*a, causal=True).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+
+        dense_ms = _timeit(dense_step, q, k, v, iters=5)
+        row.update(dense_ms=round(dense_ms, 2),
+                   speedup=round(dense_ms / fused_ms, 2))
+    except Exception as e:  # noqa: BLE001 — the failure IS the datapoint
+        row["dense_error"] = f"{type(e).__name__}: {e}"[:200]
+    ROWS.append(row)
+    print(f"flash_train_long {row['shape']}: fused {fused_ms:.2f} ms, "
+          f"dense {row.get('dense_ms', row.get('dense_error'))}")
+
+
 def bench_bn_matmul():
     """Fused BN+ReLU->matmul vs the XLA-composed reference, fwd+bwd, on
     the ResNet stage-4 next-conv1 shape (bs128: M=6272, K=2048, N=512 —
@@ -228,8 +280,8 @@ if __name__ == "__main__":
 
     # each bench is independent: a Mosaic failure in one must not cost
     # the rows already measured (first-contact evidence matters most)
-    for fn in (bench_lstm, bench_gru, bench_flash, bench_bn_matmul,
-               bench_bn_conv3x3):
+    for fn in (bench_lstm, bench_gru, bench_flash, bench_flash_long,
+               bench_bn_matmul, bench_bn_conv3x3):
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — record and continue

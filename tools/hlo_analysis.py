@@ -203,17 +203,7 @@ def child_bytes(args) -> None:
     text_dir = os.environ.get("PDTPU_HLO_TEXT_DIR")
     if text_dir and not glob.glob(
             os.path.join(text_dir, "*after_optimizations.txt")):
-        import jax
-
-        (_, compiled) = next(iter(exe._cache.values()))
-        scope = fluid.global_scope()
-        block = fluid.default_main_program().blocks[0]
-        feed_vals = exe._prepare_feeds(block, feed)
-        state_w = {n: scope.find(n) for n in compiled.rw_state}
-        state_r = {n: scope.find(n) for n in compiled.external_reads}
-        txt = compiled.fn.lower(
-            state_w, state_r, feed_vals, jax.random.PRNGKey(0)
-        ).compile().as_text()
+        txt = exe.optimized_hlo(feed=feed, fetch_list=[avg_cost])
         with open(os.path.join(
                 text_dir, "pjrt_module.after_optimizations.txt"), "w") as f:
             f.write(txt)
