@@ -65,7 +65,9 @@ def gru_forward(x_proj, h0, w, lengths, interpret: bool = False):
 
     B, T, H3 = x_proj.shape
     H = H3 // 3
-    mask = step_mask(lengths, T, x_proj.dtype)
+    # f32 mask regardless of compute dtype: dynamic sublane slicing of a
+    # packed bf16 [T,B] block crashes the Mosaic compiler (see lstm.py)
+    mask = step_mask(lengths, T, jnp.float32)
     xt = jnp.moveaxis(x_proj, 1, 0)
 
     hs, hT = pl.pallas_call(

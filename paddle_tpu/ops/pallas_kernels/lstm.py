@@ -78,7 +78,11 @@ def lstm_forward(x_proj, h0, c0, w, lengths, interpret: bool = False):
 
     B, T, H4 = x_proj.shape
     H = H4 // 4
-    mask = step_mask(lengths, T, x_proj.dtype)
+    # mask stays f32 regardless of compute dtype: dynamic sublane slicing
+    # of a packed bf16 [T,B] block crashes the Mosaic compiler (r4 bisect:
+    # the bf16 training program's remote-compile 500 was exactly this),
+    # and the kernel consumes it as f32 anyway
+    mask = step_mask(lengths, T, jnp.float32)
     xt = jnp.moveaxis(x_proj, 1, 0)   # [T, B, 4H] time-major
     mt = mask.T                        # [T, B]
 
