@@ -339,6 +339,48 @@ def bench_cnn_train(model_name, warmup, iters):
     }
 
 
+def bench_gpt_train(warmup, iters):
+    """Decoder-only LM (models/transformer.py) tokens/s — beyond-reference
+    model family (the 2018 reference predates transformers, so there is no
+    anchor row; vs_baseline reports 0).  Exercises the flash-attention
+    Pallas kernel inside a full training program.  Opt-in via
+    BENCH_MODEL=gpt.  Overrides: BENCH_BS, BENCH_SEQLEN, BENCH_DIM,
+    BENCH_NLAYERS."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    bs = int(os.environ.get("BENCH_BS", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQLEN", "1024"))
+    dim = int(os.environ.get("BENCH_DIM", "512"))
+    n_layers = int(os.environ.get("BENCH_NLAYERS", "8"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    loss = transformer.build_lm_train_program(
+        seq_len=seq_len, vocab_size=32000, dim=dim,
+        n_layers=n_layers, n_heads=max(1, dim // 64), dtype=dtype)
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 32000, (bs, seq_len, 1)).astype(np.int64)
+    feed = _stage(place, {
+        "tokens": jnp.asarray(toks),
+        "targets": jnp.asarray(np.roll(toks, -1, axis=1)),
+    })
+    dt = _timed_loop(exe, feed, loss, warmup, iters)
+    tok_s = bs * seq_len / dt
+    return {
+        "metric": f"gpt_d{dim}_l{n_layers}_train_tok_per_s_{dtype}"
+                  f"_bs{bs}_seq{seq_len}",
+        "value": round(tok_s, 0),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "note": "beyond-reference model family: no anchor row exists",
+    }
+
+
 def bench_lstm_train(warmup, iters):
     """Reference RNN baseline shape (benchmark/README.md:119): stacked
     2xLSTM+fc text classification, bs64 h512 seqlen100 -> 184 ms/batch on
@@ -438,6 +480,9 @@ def main():
 
     if model in ("alexnet", "googlenet", "vgg"):
         finish(bench_cnn_train(model, warmup, iters))
+        return
+    if model == "gpt":
+        finish(bench_gpt_train(warmup, iters))
         return
     if model != "all":
         finish(runners[model](warmup, iters))

@@ -1,0 +1,109 @@
+"""Decoder-only transformer language model (GPT-style).
+
+Beyond-reference model family: the 2018 reference predates transformers
+(SURVEY.md §2.16 "Pipeline/TP/SP/EP/CP — absent"), but this framework's
+long-context tier (flash attention kernels, ring/Ulysses sequence
+parallelism, zigzag causal schedule) needs a flagship that exercises it
+end-to-end.  Built entirely from the fluid layer surface — embedding,
+layer_norm, multi_head_attention, fc — so the same program runs
+single-chip (flash Pallas kernels on the MXU) or sharded dp×sp under
+ParallelExecutor with no model changes.
+
+Architecture: pre-LN residual blocks (LN → causal MHA → +x; LN → MLP
+gelu → +x), learned position embeddings, final LN, untied LM head.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .. import layers
+from ..framework.initializer import NormalInitializer
+from ..framework.layer_helper import LayerHelper
+from ..layers import fluid_compat
+
+
+def _positions(tokens, dim, max_len, dtype):
+    """Learned position table [max_len, D] sliced to the program's T and
+    broadcast-added at axis 1 (reference elementwise broadcast semantics:
+    y aligns to x from `axis`)."""
+    T = tokens.shape[1]
+    assert T is not None and T <= max_len, (T, max_len)
+    table = fluid_compat.create_parameter(
+        [max_len, dim], dtype, name="pos_embedding",
+        default_initializer=NormalInitializer(scale=0.02))
+    helper = LayerHelper("position_slice")
+    pos = helper.create_tmp_variable(dtype, shape=(T, dim))
+    helper.append_op("slice", inputs={"Input": [table.name]},
+                     outputs={"Out": [pos.name]},
+                     attrs={"axes": [0], "starts": [0], "ends": [int(T)]})
+    return pos
+
+
+def decoder_lm(tokens, vocab_size, dim, n_layers, n_heads, max_len,
+               mlp_ratio=4, dtype="float32", dropout_prob=0.0,
+               is_test=False, remat=False, sp_mode="ring",
+               sp_schedule="zigzag"):
+    """tokens [B, T, 1] int64 → logits [B, T, vocab_size].
+
+    sp_mode/sp_schedule flow to scaled_dot_product_attention: on a mesh
+    with an 'sp' axis the sequence dimension shards and attention runs as
+    a causal flash ring (zigzag = load-balanced) or Ulysses all-to-all;
+    single-chip they pick the fused flash kernel when eligible."""
+    emb = layers.embedding(tokens, size=[vocab_size, dim], dtype=dtype)
+    pos = _positions(tokens, dim, max_len, dtype)
+    x = layers.elementwise_add(emb, pos, axis=1)
+    if dropout_prob:
+        x = layers.dropout(x, dropout_prob, is_test=is_test)
+
+    blk = (layers.recompute if remat else contextlib.nullcontext)
+    for _ in range(n_layers):
+        with blk():
+            h = layers.layer_norm(x, begin_norm_axis=2)
+            a = layers.multi_head_attention(
+                h, h, h, num_heads=n_heads, causal=True,
+                sp_mode=sp_mode, sp_schedule=sp_schedule)
+            if dropout_prob:
+                a = layers.dropout(a, dropout_prob, is_test=is_test)
+            x = layers.elementwise_add(x, a)
+            h = layers.layer_norm(x, begin_norm_axis=2)
+            m = layers.fc(h, dim * mlp_ratio, num_flatten_dims=2,
+                          act="gelu")
+            m = layers.fc(m, dim, num_flatten_dims=2)
+            if dropout_prob:
+                m = layers.dropout(m, dropout_prob, is_test=is_test)
+            x = layers.elementwise_add(x, m)
+
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    return layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
+
+
+def lm_loss(logits, targets, dtype="float32"):
+    """Next-token loss: logits [B, T, V] vs targets [B, T, 1] (already
+    shifted by the data pipeline).  Softmax runs in f32 regardless of the
+    model compute dtype."""
+    V = logits.shape[-1]
+    flat = layers.reshape(logits, [-1, V])
+    if dtype != "float32":
+        flat = layers.cast(flat, "float32")
+    tgt = layers.reshape(targets, [-1, 1])
+    return layers.mean(layers.softmax_with_cross_entropy(flat, tgt))
+
+
+def build_lm_train_program(seq_len, vocab_size=32000, dim=512,
+                           n_layers=8, n_heads=8, dtype="bfloat16",
+                           learning_rate=3e-4, remat=False,
+                           sp_mode="ring", sp_schedule="zigzag"):
+    """Bench/test entry: data vars + decoder_lm + Adam; returns the loss
+    var.  Feed 'tokens' and 'targets' as [B, T, 1] int64 — the batch dim
+    is free (layers.data programs accept any batch size)."""
+    from .. import optimizer as opt
+
+    tokens = layers.data("tokens", shape=[seq_len, 1], dtype="int64")
+    targets = layers.data("targets", shape=[seq_len, 1], dtype="int64")
+    logits = decoder_lm(tokens, vocab_size, dim, n_layers, n_heads,
+                        max_len=seq_len, dtype=dtype, remat=remat,
+                        sp_mode=sp_mode, sp_schedule=sp_schedule)
+    loss = lm_loss(logits, targets, dtype=dtype)
+    opt.Adam(learning_rate=learning_rate).minimize(loss)
+    return loss

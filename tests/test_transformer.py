@@ -1,0 +1,103 @@
+"""Decoder-only transformer LM (models/transformer.py): convergence on
+one device, dp x sp sharded convergence, and single/sharded parity of
+the compiled step.  Beyond-reference family — exercises the flash
+attention dispatch and the zigzag causal ring end-to-end from the fluid
+layer surface."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer
+
+
+def _data(vocab, bs, T, seed=0):
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, vocab, (bs, T, 1)).astype(np.int64)
+    return toks, np.roll(toks, -1, axis=1)
+
+
+def test_lm_trains_single_device():
+    loss = transformer.build_lm_train_program(
+        seq_len=32, vocab_size=100, dim=32, n_layers=2,
+        n_heads=2, dtype="float32", learning_rate=1e-2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    toks, tgts = _data(100, 2, 32)
+    ls = []
+    for _ in range(40):
+        (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
+                        fetch_list=[loss])
+        ls.append(float(np.asarray(lv)))
+    assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
+
+
+def test_lm_trains_dp_sp_sharded():
+    """Same program, dp=4 x sp=2 mesh: the sequence axis shards and the
+    causal attention runs as the zigzag flash ring."""
+    from paddle_tpu.parallel import ParallelExecutor
+
+    loss = transformer.build_lm_train_program(
+        seq_len=64, vocab_size=128, dim=64, n_layers=2,
+        n_heads=4, dtype="float32", learning_rate=1e-2)
+    pe = ParallelExecutor(axes={"dp": 4, "sp": 2})
+    pe.run(fluid.default_startup_program())
+    toks, tgts = _data(128, 4, 64)
+    ls = []
+    for _ in range(15):
+        (lv,) = pe.run(feed={"tokens": toks, "targets": tgts},
+                       fetch_list=[loss])
+        ls.append(float(np.asarray(lv)))
+    assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])
+
+
+def test_lm_sharded_matches_single_step():
+    """One optimizer step: dp x sp sharded loss equals the single-device
+    loss on the identical program and batch (same seed -> same init)."""
+    from paddle_tpu.parallel import ParallelExecutor
+
+    def one_step(parallel):
+        fluid.reset()
+        loss = transformer.build_lm_train_program(
+            seq_len=64, vocab_size=64, dim=32, n_layers=1,
+            n_heads=2, dtype="float32", learning_rate=1e-2)
+        if parallel:
+            exe = ParallelExecutor(axes={"dp": 2, "sp": 2})
+        else:
+            exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        toks, tgts = _data(64, 4, 64, seed=3)
+        vals = []
+        for _ in range(3):
+            (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
+                            fetch_list=[loss])
+            vals.append(float(np.asarray(lv)))
+        return vals
+
+    single = one_step(False)
+    sharded = one_step(True)
+    np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-5)
+
+
+def test_lm_generate_shapes_and_remat():
+    """remat=True builds and trains (recompute scope composes with the
+    attention dispatch); logits shape checked."""
+    from paddle_tpu import layers
+
+    tokens = layers.data("tokens", shape=[16, 1], dtype="int64")
+    logits = transformer.decoder_lm(tokens, vocab_size=50, dim=32,
+                                    n_layers=1, n_heads=2, max_len=16,
+                                    dtype="float32", remat=True)
+    assert tuple(logits.shape[-2:]) == (16, 50)
+    targets = layers.data("targets", shape=[16, 1], dtype="int64")
+    loss = transformer.lm_loss(logits, targets)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    toks, tgts = _data(50, 2, 16)
+    (l0,) = exe.run(feed={"tokens": toks, "targets": tgts},
+                    fetch_list=[loss])
+    for _ in range(10):
+        (l1,) = exe.run(feed={"tokens": toks, "targets": tgts},
+                        fetch_list=[loss])
+    assert float(np.asarray(l1)) < float(np.asarray(l0))
