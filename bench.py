@@ -357,9 +357,14 @@ def bench_gpt_train(warmup, iters):
     n_layers = int(os.environ.get("BENCH_NLAYERS", "8"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"  # long-T memory lever
+    n_heads = int(os.environ.get("BENCH_NHEADS", "0")) or max(1, dim // 64)
+    while dim % n_heads:  # head_dim~64 is a hint; divisibility is the law
+        n_heads -= 1
     loss = transformer.build_lm_train_program(
         seq_len=seq_len, vocab_size=32000, dim=dim,
-        n_layers=n_layers, n_heads=max(1, dim // 64), dtype=dtype)
+        n_layers=n_layers, n_heads=n_heads, dtype=dtype,
+        remat=remat)
     place = fluid.default_place()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
@@ -373,7 +378,7 @@ def bench_gpt_train(warmup, iters):
     tok_s = bs * seq_len / dt
     return {
         "metric": f"gpt_d{dim}_l{n_layers}_train_tok_per_s_{dtype}"
-                  f"_bs{bs}_seq{seq_len}",
+                  f"_bs{bs}_seq{seq_len}{'_remat' if remat else ''}",
         "value": round(tok_s, 0),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,

@@ -6,4 +6,5 @@ from . import deepfm  # noqa: F401
 from . import image_models  # noqa: F401
 from . import resnet  # noqa: F401
 from . import seq2seq  # noqa: F401
+from . import transformer  # noqa: F401
 from . import vgg  # noqa: F401

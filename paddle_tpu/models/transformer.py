@@ -29,8 +29,11 @@ def _positions(tokens, dim, max_len, dtype):
     y aligns to x from `axis`)."""
     T = tokens.shape[1]
     assert T is not None and T <= max_len, (T, max_len)
+    # no explicit name: two decoder_lm towers in one program (train +
+    # is_test eval) must get independent tables, so let LayerHelper
+    # unique-name it like every other parameter here
     table = fluid_compat.create_parameter(
-        [max_len, dim], dtype, name="pos_embedding",
+        [max_len, dim], dtype,
         default_initializer=NormalInitializer(scale=0.02))
     helper = LayerHelper("position_slice")
     pos = helper.create_tmp_variable(dtype, shape=(T, dim))
