@@ -358,9 +358,16 @@ def bench_gpt_train(warmup, iters):
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"  # long-T memory lever
-    n_heads = int(os.environ.get("BENCH_NHEADS", "0")) or max(1, dim // 64)
-    while dim % n_heads:  # head_dim~64 is a hint; divisibility is the law
-        n_heads -= 1
+    explicit_nh = int(os.environ.get("BENCH_NHEADS", "0"))
+    if explicit_nh:
+        if dim % explicit_nh:  # explicit config errors must fail loudly
+            raise ValueError(
+                f"BENCH_NHEADS={explicit_nh} does not divide dim={dim}")
+        n_heads = explicit_nh
+    else:
+        n_heads = max(1, dim // 64)
+        while dim % n_heads:  # head_dim~64 is a hint, not a constraint
+            n_heads -= 1
     loss = transformer.build_lm_train_program(
         seq_len=seq_len, vocab_size=32000, dim=dim,
         n_layers=n_layers, n_heads=n_heads, dtype=dtype,
@@ -377,8 +384,8 @@ def bench_gpt_train(warmup, iters):
     dt = _timed_loop(exe, feed, loss, warmup, iters)
     tok_s = bs * seq_len / dt
     return {
-        "metric": f"gpt_d{dim}_l{n_layers}_train_tok_per_s_{dtype}"
-                  f"_bs{bs}_seq{seq_len}{'_remat' if remat else ''}",
+        "metric": f"gpt_d{dim}_l{n_layers}_h{n_heads}_train_tok_per_s"
+                  f"_{dtype}_bs{bs}_seq{seq_len}{'_remat' if remat else ''}",
         "value": round(tok_s, 0),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,

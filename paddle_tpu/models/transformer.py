@@ -93,6 +93,85 @@ def lm_loss(logits, targets, dtype="float32"):
     return layers.mean(layers.softmax_with_cross_entropy(flat, tgt))
 
 
+class DecoderLM:
+    """Decoder-only LM with a generation path.
+
+    `logits(tokens)` builds the training/eval tower via decoder_lm and
+    RECORDS its parameters in creation order; `generate(prompt, max_gen)`
+    wires those same parameters into the one-op KV-cached greedy decoder
+    (ops/transformer_ops.py gpt_decode) — the TPU-native counterpart of
+    the reference's RecurrentGradientMachine generation mode
+    (RecurrentGradientMachine.h:307) for this model family."""
+
+    # creation order inside decoder_lm: emb W, pos table, then per layer
+    # [ln1 s, ln1 b, wq, wk, wv, wo, ln2 s, ln2 b, w1, b1, w2, b2],
+    # then final [ln s, ln b, head w]
+    _PER_LAYER = 12
+
+    def __init__(self, vocab_size, dim, n_layers, n_heads, max_len,
+                 mlp_ratio=4, dtype="float32"):
+        self.vocab_size, self.dim = vocab_size, dim
+        self.n_layers, self.n_heads = n_layers, n_heads
+        self.max_len, self.mlp_ratio = max_len, mlp_ratio
+        self.dtype = dtype
+        self._params = None
+
+    def logits(self, tokens, **kw):
+        from ..framework.core import default_main_program
+
+        if self._params is not None:
+            raise RuntimeError(
+                "DecoderLM.logits() already built this model's tower — "
+                "one instance owns one parameter set")
+        block = default_main_program().global_block()
+        before = set(block.vars)
+        out = decoder_lm(tokens, self.vocab_size, self.dim, self.n_layers,
+                         self.n_heads, self.max_len,
+                         mlp_ratio=self.mlp_ratio, dtype=self.dtype, **kw)
+        from ..framework.core import Parameter
+
+        new = [v for n, v in block.vars.items()
+               if n not in before and isinstance(v, Parameter)]
+        want = 2 + self._PER_LAYER * self.n_layers + 3
+        assert len(new) == want, (len(new), want)
+        self._params = new
+        return out
+
+    def generate(self, prompt, max_gen, eos_id=-1):
+        """prompt [B, P, 1] int64 → Ids [B, max_gen] int64 (greedy).
+
+        Build inside its OWN program (`with fluid.program_guard(p):`) —
+        running the training program's block would demand the tower's
+        `tokens` feed; parameters are shared through the scope by name
+        (the reference's separate generation-config pattern)."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        P = prompt.shape[1]
+        assert P + max_gen <= self.max_len, (P, max_gen, self.max_len)
+        p = self._params
+        L = self.n_layers
+        per = lambda off: [p[2 + i * self._PER_LAYER + off].name
+                           for i in range(L)]
+        helper = LayerHelper("gpt_decode")
+        ids = helper.create_tmp_variable("int64", shape=(-1, max_gen),
+                                         stop_gradient=True)
+        helper.append_op(
+            "gpt_decode",
+            inputs={"Tokens": [prompt.name], "Emb": [p[0].name],
+                    "Pos": [p[1].name],
+                    "Ln1S": per(0), "Ln1B": per(1), "WQ": per(2),
+                    "WK": per(3), "WV": per(4), "WO": per(5),
+                    "Ln2S": per(6), "Ln2B": per(7), "W1": per(8),
+                    "B1": per(9), "W2": per(10), "B2": per(11),
+                    "LnfS": [p[-3].name], "LnfB": [p[-2].name],
+                    "WHead": [p[-1].name]},
+            outputs={"Ids": [ids.name]},
+            attrs={"n_heads": self.n_heads, "max_gen": int(max_gen),
+                   "eos_id": int(eos_id), "eps": 1e-5},
+        )
+        return ids
+
+
 def build_lm_train_program(seq_len, vocab_size=32000, dim=512,
                            n_layers=8, n_heads=8, dtype="bfloat16",
                            learning_rate=3e-4, remat=False,

@@ -17,6 +17,7 @@ from . import crf_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
+from . import transformer_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from .registry import EmitContext, get_op_info, has_op, register_op, registered_ops  # noqa: F401

@@ -1,0 +1,147 @@
+"""Transformer generation ops.
+
+`gpt_decode`: KV-cached greedy decoding for the decoder-only LM
+(models/transformer.py) as ONE op — prefill plus the whole generation
+loop compile into a single XLA program (lax.fori_loop), the TPU-first
+counterpart of the reference's RecurrentGradientMachine generation mode
+(gradientmachines/RecurrentGradientMachine.h:307 generateSequence) and
+the v2 SequenceGenerator (api/PaddleAPI.h:1025).  The KV cache is a
+static [L, B, H, P+G, dh] buffer updated with dynamic_update_slice —
+no dynamic shapes anywhere, so the loop lowers to a compiled while.
+"""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("gpt_decode", grad=None)
+def gpt_decode(ctx, ins, attrs):
+    """Greedy KV-cached generation.
+
+    Inputs: Tokens [B,P,1] int64 prompt; Emb [V,D]; Pos [max_len,D];
+    per-layer lists (length L): Ln1S/Ln1B [D], WQ/WK/WV/WO [D,D],
+    Ln2S/Ln2B [D], W1 [D,4D], B1 [4D], W2 [4D,D], B2 [D]; LnfS/LnfB [D];
+    WHead [D,V].
+    Attrs: n_heads, max_gen, eos_id (-1 disables early-stop masking),
+    eps (layer_norm epsilon).
+    Output: Ids [B, max_gen] int64 (positions after an emitted eos hold
+    eos).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nh = int(attrs["n_heads"])
+    G = int(attrs["max_gen"])
+    eos = int(attrs.get("eos_id", -1))
+    eps = float(attrs.get("eps", 1e-5))
+
+    tokens = ins["Tokens"][0]
+    if tokens.ndim == 3:
+        tokens = tokens[:, :, 0]
+    tokens = tokens.astype(jnp.int32)
+    emb = ins["Emb"][0]
+    pos = ins["Pos"][0]
+    L = len(ins["WQ"])
+    B, P = tokens.shape
+    D = emb.shape[1]
+    dh = D // nh
+    T = P + G
+    assert pos.shape[0] >= T, (pos.shape, T)
+    cdt = emb.dtype  # compute dtype follows the parameters
+
+    def ln(x, s, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+    def heads(x):  # [B,t,D] -> [B,nh,t,dh]
+        return x.reshape(B, -1, nh, dh).transpose(0, 2, 1, 3)
+
+    def merge(x):  # [B,nh,t,dh] -> [B,t,D]
+        return x.transpose(0, 2, 1, 3).reshape(B, -1, D)
+
+    scale = 1.0 / (dh ** 0.5)
+
+    def block(i, x, attend):
+        """One decoder block; `attend` maps (q,k,v) heads to context."""
+        h = ln(x, ins["Ln1S"][i], ins["Ln1B"][i])
+        q = heads(h @ ins["WQ"][i])
+        k = heads(h @ ins["WK"][i])
+        v = heads(h @ ins["WV"][i])
+        a = merge(attend(i, q, k, v)) @ ins["WO"][i]
+        x = x + a
+        h = ln(x, ins["Ln2S"][i], ins["Ln2B"][i])
+        m = jax.nn.gelu(h @ ins["W1"][i] + ins["B1"][i])
+        return x + (m @ ins["W2"][i] + ins["B2"][i])
+
+    # ---- prefill: causal self-attention over the prompt, cache K/V ----
+    kc0 = jnp.zeros((L, B, nh, T, dh), cdt)
+    vc0 = jnp.zeros((L, B, nh, T, dh), cdt)
+    caches = {"k": kc0, "v": vc0}
+
+    causal = jnp.tril(jnp.ones((P, P), bool))
+
+    def prefill_attend(i, q, k, v):
+        caches["k"] = caches["k"].at[i, :, :, :P].set(k)
+        caches["v"] = caches["v"].at[i, :, :, :P].set(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        s = jnp.where(causal, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    x = emb[tokens] + pos[:P].astype(cdt)
+    for i in range(L):
+        x = block(i, x, prefill_attend)
+    x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
+    logits = (x[:, -1].astype(jnp.float32) @
+              ins["WHead"][0].astype(jnp.float32))
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+
+    # ---- decode loop: one token per step against the cache ----------
+    kcache, vcache = caches["k"], caches["v"]
+    # positions 0..P+t are valid at step t (mask keeps shapes static)
+    pos_ids = jnp.arange(T)
+
+    def step(t, carry):
+        out_ids, cur, kc, vc, done = carry
+        xt = emb[cur][:, None, :] + jax.lax.dynamic_slice_in_dim(
+            pos, P + t, 1, 0).astype(cdt)  # [B,1,D]
+        # the caches thread through the layer walk as the CARRIED arrays
+        # (dynamic_update_slice chains XLA can alias in place) — stacking
+        # per-layer copies back together would materialize a second full
+        # KV cache every step (r4 review)
+        hold = {"k": kc, "v": vc}
+
+        def attend(i, q, k, v):
+            hold["k"] = jax.lax.dynamic_update_slice(
+                hold["k"], k[None], (i, 0, 0, P + t, 0))
+            hold["v"] = jax.lax.dynamic_update_slice(
+                hold["v"], v[None], (i, 0, 0, P + t, 0))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, hold["k"][i]).astype(
+                jnp.float32) * scale
+            s = jnp.where(pos_ids[None, None, None, :] <= P + t, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, hold["v"][i])
+
+        x = xt
+        for i in range(L):
+            x = block(i, x, attend)
+        x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
+        logit = (x[:, 0].astype(jnp.float32) @
+                 ins["WHead"][0].astype(jnp.float32))
+        nxt = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+        if eos >= 0:
+            # once THIS step emitted eos, every later token is eos — the
+            # done update must precede the next-token masking or one
+            # post-eos garbage token leaks through
+            done = done | (cur == eos)
+            nxt = jnp.where(done, eos, nxt)
+        out_ids = out_ids.at[:, t].set(cur)
+        return out_ids, nxt, hold["k"], hold["v"], done
+
+    out0 = jnp.zeros((B, G), jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+    out_ids, _, _, _, _ = jax.lax.fori_loop(
+        0, G, step, (out0, first, kcache, vcache, done0))
+    return {"Ids": [out_ids.astype(jnp.int64)]}
