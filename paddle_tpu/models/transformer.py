@@ -137,15 +137,24 @@ class DecoderLM:
         self._params = new
         return out
 
-    def generate(self, prompt, max_gen, eos_id=-1):
-        """prompt [B, P, 1] int64 → Ids [B, max_gen] int64 (greedy).
+    def generate(self, prompt, max_gen, eos_id=-1, temperature=0.0,
+                 top_k=0):
+        """prompt [B, P, 1] int64 → Ids [B, max_gen] int64.
 
+        temperature=0 is greedy argmax; >0 samples softmax(logits/T),
+        optionally truncated to the top_k most likely tokens.  Sampling
+        keys fold the program's random_seed with the executor's step
+        counter, so each run draws fresh tokens (dropout semantics);
+        replay requires the same (seed, step) pair, not just the seed.
         Build inside its OWN program (`with fluid.program_guard(p):`) —
         running the training program's block would demand the tower's
         `tokens` feed; parameters are shared through the scope by name
         (the reference's separate generation-config pattern)."""
         if self._params is None:
             raise RuntimeError("build the tower with .logits() first")
+        if top_k > self.vocab_size:
+            raise ValueError(
+                f"top_k={top_k} exceeds vocab_size={self.vocab_size}")
         P = prompt.shape[1]
         assert P + max_gen <= self.max_len, (P, max_gen, self.max_len)
         p = self._params
@@ -167,7 +176,8 @@ class DecoderLM:
                     "WHead": [p[-1].name]},
             outputs={"Ids": [ids.name]},
             attrs={"n_heads": self.n_heads, "max_gen": int(max_gen),
-                   "eos_id": int(eos_id), "eps": 1e-5},
+                   "eos_id": int(eos_id), "eps": 1e-5,
+                   "temperature": float(temperature), "top_k": int(top_k)},
         )
         return ids
 

@@ -24,7 +24,9 @@ def gpt_decode(ctx, ins, attrs):
     Ln2S/Ln2B [D], W1 [D,4D], B1 [4D], W2 [4D,D], B2 [D]; LnfS/LnfB [D];
     WHead [D,V].
     Attrs: n_heads, max_gen, eos_id (-1 disables early-stop masking),
-    eps (layer_norm epsilon).
+    eps (layer_norm epsilon), temperature (0.0 = greedy argmax; > 0
+    samples softmax(logits/temperature)), top_k (with sampling: restrict
+    to the k most likely tokens; 0 = full vocab).
     Output: Ids [B, max_gen] int64 (positions after an emitted eos hold
     eos).
     """
@@ -35,6 +37,22 @@ def gpt_decode(ctx, ins, attrs):
     G = int(attrs["max_gen"])
     eos = int(attrs.get("eos_id", -1))
     eps = float(attrs.get("eps", 1e-5))
+    temp = float(attrs.get("temperature", 0.0))
+    top_k = int(attrs.get("top_k", 0))
+    base_key = ctx.rng(attrs)
+
+    def pick(logits_f32, t):
+        """Next-token rule: greedy, or temperature/top-k sampling with a
+        per-step key (deterministic replay: base key folded with t)."""
+        if temp <= 0.0:
+            return jnp.argmax(logits_f32, axis=-1).astype(jnp.int32)
+        z = logits_f32 / temp
+        if top_k > 0:
+            k_eff = min(top_k, z.shape[-1])  # top_k > V would fail in
+            kth = jax.lax.top_k(z, k_eff)[0][:, -1:]  # lax.top_k
+            z = jnp.where(z < kth, -1e30, z)
+        key = jax.random.fold_in(base_key, t)
+        return jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
 
     tokens = ins["Tokens"][0]
     if tokens.ndim == 3:
@@ -96,7 +114,8 @@ def gpt_decode(ctx, ins, attrs):
     x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
     logits = (x[:, -1].astype(jnp.float32) @
               ins["WHead"][0].astype(jnp.float32))
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+    first = pick(logits, G)  # [B]; G = a step index the loop never uses
+    # (fold_in rejects negatives)
 
     # ---- decode loop: one token per step against the cache ----------
     kcache, vcache = caches["k"], caches["v"]
@@ -130,18 +149,21 @@ def gpt_decode(ctx, ins, attrs):
         x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
         logit = (x[:, 0].astype(jnp.float32) @
                  ins["WHead"][0].astype(jnp.float32))
-        nxt = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+        nxt = pick(logit, t)
         if eos >= 0:
-            # once THIS step emitted eos, every later token is eos — the
+            # once slot t's token is eos, every later token is eos — the
             # done update must precede the next-token masking or one
             # post-eos garbage token leaks through
             done = done | (cur == eos)
             nxt = jnp.where(done, eos, nxt)
-        out_ids = out_ids.at[:, t].set(cur)
+        out_ids = out_ids.at[:, t + 1].set(nxt)
         return out_ids, nxt, hold["k"], hold["v"], done
 
-    out0 = jnp.zeros((B, G), jnp.int32)
+    # slot 0 comes from the prefill; the loop runs G-1 steps writing slot
+    # t+1 — running G steps and discarding the last forward would waste a
+    # whole transformer step per call (r4 review)
+    out0 = jnp.zeros((B, G), jnp.int32).at[:, 0].set(first)
     done0 = jnp.zeros((B,), bool)
     out_ids, _, _, _, _ = jax.lax.fori_loop(
-        0, G, step, (out0, first, kcache, vcache, done0))
+        0, G - 1, step, (out0, first, kcache, vcache, done0))
     return {"Ids": [out_ids.astype(jnp.int64)]}

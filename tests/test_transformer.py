@@ -158,3 +158,34 @@ def test_lm_generate_eos_padding():
         hits = np.where(row == 0)[0]
         if hits.size:
             assert (row[hits[0]:] == 0).all(), row
+
+
+def test_lm_generate_sampling_topk():
+    """temperature/top_k sampling: differs from greedy, and with top_k=1
+    collapses BACK to greedy (the distribution degenerates to the
+    argmax).  Greedy replays exactly across runs; sampled output draws a
+    fresh key per run (the executor folds the program key with its step
+    counter — dropout semantics)."""
+    from paddle_tpu import layers
+
+    V, P, G = 40, 4, 6
+    lm = transformer.DecoderLM(V, 32, 1, 2, max_len=P + G, dtype="float32")
+    tokens = layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    lm.logits(tokens)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = layers.data("prompt", shape=[P, 1], dtype="int64")
+        greedy = lm.generate(prompt, max_gen=G)
+        sampled = lm.generate(prompt, max_gen=G, temperature=1.5)
+        k1 = lm.generate(prompt, max_gen=G, temperature=1.5, top_k=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pr = np.random.RandomState(2).randint(0, V, (4, P, 1)).astype(np.int64)
+    g1, s1, t1 = (np.asarray(v) for v in exe.run(
+        gen_prog, feed={"prompt": pr}, fetch_list=[greedy, sampled, k1]))
+    g2, s2, _ = (np.asarray(v) for v in exe.run(
+        gen_prog, feed={"prompt": pr}, fetch_list=[greedy, sampled, k1]))
+    np.testing.assert_array_equal(g1, g2)  # greedy is run-invariant
+    np.testing.assert_array_equal(t1, g1)  # top_k=1 == greedy
+    assert (s1 != g1).any()  # hot sampling explores off the argmax path
+    assert s2.shape == s1.shape
