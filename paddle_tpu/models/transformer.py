@@ -158,6 +158,17 @@ class DecoderLM:
         P = prompt.shape[1]
         assert P + max_gen <= self.max_len, (P, max_gen, self.max_len)
         p = self._params
+        # declare the tower's parameters in THIS program too: a
+        # generation program built under program_guard must carry its own
+        # var declarations for save_inference_model to validate and
+        # persist the weights (values still come from the shared scope)
+        from ..framework.core import default_main_program
+
+        gb = default_main_program().global_block()
+        for v in p:
+            if v.name not in gb.vars:
+                gb.create_parameter(name=v.name, shape=v.shape,
+                                    dtype=v.dtype)
         L = self.n_layers
         per = lambda off: [p[2 + i * self._PER_LAYER + off].name
                            for i in range(L)]

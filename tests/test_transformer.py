@@ -189,3 +189,33 @@ def test_lm_generate_sampling_topk():
     np.testing.assert_array_equal(t1, g1)  # top_k=1 == greedy
     assert (s1 != g1).any()  # hot sampling explores off the argmax path
     assert s2.shape == s1.shape
+
+
+def test_lm_generation_program_save_load_roundtrip(tmp_path):
+    """Deployment path: the generation program (gpt_decode with per-layer
+    input LISTS and float attrs) survives the proto round-trip through
+    save_inference_model/load_inference_model and reproduces the same
+    ids from the reloaded weights."""
+    from paddle_tpu import layers
+
+    V, P, G = 30, 4, 5
+    lm = transformer.DecoderLM(V, 32, 2, 2, max_len=P + G, dtype="float32")
+    tokens = layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    lm.logits(tokens)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = layers.data("prompt", shape=[P, 1], dtype="int64")
+        ids = lm.generate(prompt, max_gen=G)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pr = np.random.RandomState(1).randint(0, V, (2, P, 1)).astype(np.int64)
+    (before,) = exe.run(gen_prog, feed={"prompt": pr}, fetch_list=[ids])
+
+    d = str(tmp_path)
+    fluid.io.save_inference_model(d, ["prompt"], [ids], exe,
+                                  main_program=gen_prog)
+    fluid.reset()  # fresh scope+programs: everything must come from disk
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog2, feeds, fetches = fluid.io.load_inference_model(d, exe2)
+    (after,) = exe2.run(prog2, feed={feeds[0]: pr}, fetch_list=fetches)
+    np.testing.assert_array_equal(np.asarray(after), np.asarray(before))
