@@ -115,10 +115,10 @@ def _mark(stage: str):
     print(f"[bench-stage] {stage}", file=sys.stderr, flush=True)
 
 
-def _timed_loop(exe, feed, fetch, warmup, iters):
+def _timed_loop(exe, feed, fetch, warmup, iters, program=None):
     _mark("compile+warmup")
     for _ in range(warmup):
-        (out,) = exe.run(feed=feed, fetch_list=[fetch])
+        (out,) = exe.run(program, feed=feed, fetch_list=[fetch])
     _mark("timing")
     # best-of-N passes: the tunneled transport injects multi-x transient
     # slowdowns (bs16 inference observed 1382<->3026 img/s back-to-back),
@@ -129,7 +129,7 @@ def _timed_loop(exe, feed, fetch, warmup, iters):
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(iters):
-            (out,) = exe.run(feed=feed, fetch_list=[fetch],
+            (out,) = exe.run(program, feed=feed, fetch_list=[fetch],
                              return_numpy=False)
         # completion barrier by VALUE fetch, not block_until_ready: a
         # degraded tunnel session was observed (r4) acknowledging
@@ -206,39 +206,45 @@ def bench_resnet_train(warmup, iters, layout=None):
         "vs_baseline": round(img_s / RESNET_TRAIN_BASE, 2),
         "device_kind": _device_kind(),
     }
-    # MFU from XLA's own FLOP accounting (tools/profile_resnet.py method);
-    # cost analysis runs AFTER timing — its AOT executable occupies HBM —
-    # and is best-effort: a degraded tunnel must not cost the metric
-    if not os.environ.get("BENCH_NO_COST"):
-        try:
-            import jax
-
-            import paddle_tpu as fluid
-            compiled = next(c for _, c in exe._cache.values()
-                            if avg_cost.name in c.fetch_names)
-            state_w = {n: fluid.global_scope().find(n)
-                       for n in compiled.rw_state}
-            state_r = {n: fluid.global_scope().find(n)
-                       for n in compiled.external_reads}
-            cost = compiled.fn.lower(
-                state_w, state_r, feed, jax.random.PRNGKey(0)
-            ).compile().cost_analysis() or {}
-            if isinstance(cost, list):
-                cost = cost[0]
-            mfu = _mfu(float(cost.get("flops", 0.0)), dt)
-            if mfu is not None:
-                out["mfu"] = mfu
-                if mfu > 100.0:
-                    # physically impossible: the degraded-tunnel failure
-                    # mode where completion is acked without execution —
-                    # never let such a number stand unflagged
-                    out["note"] = (out.get("note", "") +
-                                   " IMPLAUSIBLE: mfu>100% — timing "
-                                   "barrier not honored by backend; "
-                                   "discard this number").strip()
-        except Exception:
-            pass
+    _attach_mfu(out, exe, avg_cost, feed, dt)
     return out
+
+
+def _attach_mfu(out, exe, fetch_var, feed, dt):
+    """MFU from XLA's own FLOP accounting (tools/profile_resnet.py
+    method) onto any mode's result.  Cost analysis runs AFTER timing —
+    its AOT executable occupies HBM — and is best-effort: a degraded
+    tunnel must not cost the metric.  BENCH_NO_COST=1 skips."""
+    if os.environ.get("BENCH_NO_COST"):
+        return
+    try:
+        import jax
+
+        import paddle_tpu as fluid
+        compiled = next(c for _, c in exe._cache.values()
+                        if fetch_var.name in c.fetch_names)
+        state_w = {n: fluid.global_scope().find(n)
+                   for n in compiled.rw_state}
+        state_r = {n: fluid.global_scope().find(n)
+                   for n in compiled.external_reads}
+        cost = compiled.fn.lower(
+            state_w, state_r, feed, jax.random.PRNGKey(0)
+        ).compile().cost_analysis() or {}
+        if isinstance(cost, list):
+            cost = cost[0]
+        mfu = _mfu(float(cost.get("flops", 0.0)), dt)
+        if mfu is not None:
+            out["mfu"] = mfu
+            if mfu > 100.0:
+                # physically impossible: the degraded-tunnel failure
+                # mode where completion is acked without execution —
+                # never let such a number stand unflagged
+                out["note"] = (out.get("note", "") +
+                               " IMPLAUSIBLE: mfu>100% — timing "
+                               "barrier not honored by backend; "
+                               "discard this number").strip()
+    except Exception:
+        pass
 
 
 def bench_resnet_infer(warmup, iters):
@@ -339,6 +345,22 @@ def bench_cnn_train(model_name, warmup, iters):
     }
 
 
+def _gpt_heads(dim: int) -> int:
+    """Head count for the gpt benches: BENCH_NHEADS (validated loudly) or
+    head_dim~64 snapped down to a divisor of dim — shared so gpt and
+    gpt_gen accept the same BENCH_DIM space."""
+    explicit = int(os.environ.get("BENCH_NHEADS", "0"))
+    if explicit:
+        if dim % explicit:  # explicit config errors must fail loudly
+            raise ValueError(
+                f"BENCH_NHEADS={explicit} does not divide dim={dim}")
+        return explicit
+    n = max(1, dim // 64)
+    while dim % n:  # head_dim~64 is a hint, not a constraint
+        n -= 1
+    return n
+
+
 def bench_gpt_train(warmup, iters):
     """Decoder-only LM (models/transformer.py) tokens/s — beyond-reference
     model family (the 2018 reference predates transformers, so there is no
@@ -358,16 +380,7 @@ def bench_gpt_train(warmup, iters):
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"  # long-T memory lever
-    explicit_nh = int(os.environ.get("BENCH_NHEADS", "0"))
-    if explicit_nh:
-        if dim % explicit_nh:  # explicit config errors must fail loudly
-            raise ValueError(
-                f"BENCH_NHEADS={explicit_nh} does not divide dim={dim}")
-        n_heads = explicit_nh
-    else:
-        n_heads = max(1, dim // 64)
-        while dim % n_heads:  # head_dim~64 is a hint, not a constraint
-            n_heads -= 1
+    n_heads = _gpt_heads(dim)
     loss = transformer.build_lm_train_program(
         seq_len=seq_len, vocab_size=32000, dim=dim,
         n_layers=n_layers, n_heads=n_heads, dtype=dtype,
@@ -383,13 +396,62 @@ def bench_gpt_train(warmup, iters):
     })
     dt = _timed_loop(exe, feed, loss, warmup, iters)
     tok_s = bs * seq_len / dt
-    return {
+    out = {
         "metric": f"gpt_d{dim}_l{n_layers}_h{n_heads}_train_tok_per_s"
                   f"_{dtype}_bs{bs}_seq{seq_len}{'_remat' if remat else ''}",
         "value": round(tok_s, 0),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "note": "beyond-reference model family: no anchor row exists",
+    }
+    _attach_mfu(out, exe, loss, feed, dt)
+    return out
+
+
+def bench_gpt_generate(warmup, iters):
+    """KV-cached generation throughput (gpt_decode): decoded tokens/sec
+    for prompt P=64 -> G=192 greedy.  Opt-in via BENCH_MODEL=gpt_gen."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    bs = int(os.environ.get("BENCH_BS", "8"))
+    dim = int(os.environ.get("BENCH_DIM", "512"))
+    n_layers = int(os.environ.get("BENCH_NLAYERS", "8"))
+    P = int(os.environ.get("BENCH_PROMPT", "64"))
+    G = int(os.environ.get("BENCH_GEN", "192"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    lm = transformer.DecoderLM(32000, dim, n_layers, _gpt_heads(dim),
+                               max_len=P + G, dtype=dtype)
+    tokens = fluid.layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    lm.logits(tokens, is_test=True)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = fluid.layers.data("prompt", shape=[P, 1], dtype="int64")
+        ids = lm.generate(prompt, max_gen=G)
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _stage(place, {
+        "prompt": jnp.asarray(
+            rng.randint(0, 32000, (bs, P, 1)).astype(np.int64)),
+    })
+
+    best = _timed_loop(exe, feed, ids, warmup, iters, program=gen_prog)
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    return {
+        "metric": f"gpt_d{dim}_l{n_layers}_decode_tok_per_s_{dtype}"
+                  f"_bs{bs}_p{P}_g{G}",
+        "value": round(bs * G / best, 0),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "note": "beyond-reference model family: no anchor row exists",
+        # this mode quarters the outer iter count — stamp the ACTUAL
+        # methodology before finish()'s setdefault records the outer one
+        "timing": f"best_of_{repeats}x{iters}_iters",
     }
 
 
@@ -495,6 +557,9 @@ def main():
         return
     if model == "gpt":
         finish(bench_gpt_train(warmup, iters))
+        return
+    if model == "gpt_gen":
+        finish(bench_gpt_generate(warmup, max(1, iters // 4)))
         return
     if model != "all":
         finish(runners[model](warmup, iters))

@@ -182,6 +182,9 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "16384", "BENCH_BS": "1",
       "BENCH_REMAT": "1", "BENCH_ITERS": "5"}, 580),
+    ("gpt_gen",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "gpt_gen", "BENCH_ITERS": "4"}, 580),
 ]
 
 
