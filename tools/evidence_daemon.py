@@ -178,9 +178,9 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "4096", "BENCH_BS": "2",
       "BENCH_ITERS": "10"}, 580),
-    ("gpt_16k_remat",
+    ("gpt_8k_remat",
      [sys.executable, "bench.py"],
-     {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "16384", "BENCH_BS": "1",
+     {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "8192", "BENCH_BS": "1",
       "BENCH_REMAT": "1", "BENCH_ITERS": "5"}, 580),
     ("gpt_gen",
      [sys.executable, "bench.py"],
