@@ -266,3 +266,22 @@ def test_executor_cache_token_never_aliases():
     exe = fluid.Executor(fluid.CPUPlace())
     k = exe._cache_key(p2, 0, {}, [])
     assert k[0] == p2._cache_token
+
+
+def test_executor_optimized_hlo_text():
+    """Executor.optimized_hlo returns the post-optimization module text —
+    the API the HLO analysis tools use on remote-compile backends where
+    --xla_dump_to writes nothing locally (r4)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    import numpy as np
+
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.fc(x, size=4)
+    loss = layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.zeros((2, 8), np.float32)}
+    exe.run(feed=feed, fetch_list=[loss])
+    txt = exe.optimized_hlo(feed=feed, fetch_list=[loss])
+    assert "HloModule" in txt and "ENTRY" in txt
