@@ -157,13 +157,54 @@ class DecoderLM:
                 f"top_k={top_k} exceeds vocab_size={self.vocab_size}")
         P = prompt.shape[1]
         assert P + max_gen <= self.max_len, (P, max_gen, self.max_len)
-        p = self._params
-        # declare the tower's parameters in THIS program too: a
-        # generation program built under program_guard must carry its own
-        # var declarations for save_inference_model to validate and
-        # persist the weights (values still come from the shared scope)
+        helper = LayerHelper("gpt_decode")
+        ids = helper.create_tmp_variable("int64", shape=(-1, max_gen),
+                                         stop_gradient=True)
+        helper.append_op(
+            "gpt_decode",
+            inputs=self._decode_inputs(prompt),
+            outputs={"Ids": [ids.name]},
+            attrs={"n_heads": self.n_heads, "max_gen": int(max_gen),
+                   "eos_id": int(eos_id), "eps": 1e-5,
+                   "temperature": float(temperature), "top_k": int(top_k)},
+        )
+        return ids
+
+    def beam_generate(self, prompt, max_gen, beam_size, eos_id=-1):
+        """prompt [B, P, 1] int64 → (Ids [B, K, max_gen] int64 sorted
+        best-first, Scores [B, K] f32 accumulated log-probs) — the
+        reference's beam generation mode
+        (RecurrentGradientMachine.h:309) on this family.  Same
+        own-program/scope-sharing contract as generate()."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        if not 1 <= beam_size <= self.vocab_size:
+            raise ValueError(
+                f"beam_size={beam_size} must be in [1, vocab_size="
+                f"{self.vocab_size}] (top-k over the vocab seeds lanes)")
+        P = prompt.shape[1]
+        assert P + max_gen <= self.max_len, (P, max_gen, self.max_len)
+        helper = LayerHelper("gpt_beam_decode")
+        ids = helper.create_tmp_variable(
+            "int64", shape=(-1, beam_size, max_gen), stop_gradient=True)
+        scores = helper.create_tmp_variable(
+            "float32", shape=(-1, beam_size), stop_gradient=True)
+        helper.append_op(
+            "gpt_beam_decode",
+            inputs=self._decode_inputs(prompt),
+            outputs={"Ids": [ids.name], "Scores": [scores.name]},
+            attrs={"n_heads": self.n_heads, "max_gen": int(max_gen),
+                   "beam_size": int(beam_size), "eos_id": int(eos_id),
+                   "eps": 1e-5},
+        )
+        return ids, scores
+
+    def _decode_inputs(self, prompt):
+        """Wire the recorded tower parameters into a decode op's slots,
+        declaring them in the current program (see generate())."""
         from ..framework.core import default_main_program
 
+        p = self._params
         gb = default_main_program().global_block()
         for v in p:
             if v.name not in gb.vars:
@@ -172,25 +213,14 @@ class DecoderLM:
         L = self.n_layers
         per = lambda off: [p[2 + i * self._PER_LAYER + off].name
                            for i in range(L)]
-        helper = LayerHelper("gpt_decode")
-        ids = helper.create_tmp_variable("int64", shape=(-1, max_gen),
-                                         stop_gradient=True)
-        helper.append_op(
-            "gpt_decode",
-            inputs={"Tokens": [prompt.name], "Emb": [p[0].name],
-                    "Pos": [p[1].name],
-                    "Ln1S": per(0), "Ln1B": per(1), "WQ": per(2),
-                    "WK": per(3), "WV": per(4), "WO": per(5),
-                    "Ln2S": per(6), "Ln2B": per(7), "W1": per(8),
-                    "B1": per(9), "W2": per(10), "B2": per(11),
-                    "LnfS": [p[-3].name], "LnfB": [p[-2].name],
-                    "WHead": [p[-1].name]},
-            outputs={"Ids": [ids.name]},
-            attrs={"n_heads": self.n_heads, "max_gen": int(max_gen),
-                   "eos_id": int(eos_id), "eps": 1e-5,
-                   "temperature": float(temperature), "top_k": int(top_k)},
-        )
-        return ids
+        return {"Tokens": [prompt.name], "Emb": [p[0].name],
+                "Pos": [p[1].name],
+                "Ln1S": per(0), "Ln1B": per(1), "WQ": per(2),
+                "WK": per(3), "WV": per(4), "WO": per(5),
+                "Ln2S": per(6), "Ln2B": per(7), "W1": per(8),
+                "B1": per(9), "W2": per(10), "B2": per(11),
+                "LnfS": [p[-3].name], "LnfB": [p[-2].name],
+                "WHead": [p[-1].name]}
 
 
 def build_lm_train_program(seq_len, vocab_size=32000, dim=512,

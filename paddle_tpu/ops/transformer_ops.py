@@ -1,13 +1,17 @@
 """Transformer generation ops.
 
-`gpt_decode`: KV-cached greedy decoding for the decoder-only LM
-(models/transformer.py) as ONE op — prefill plus the whole generation
-loop compile into a single XLA program (lax.fori_loop), the TPU-first
-counterpart of the reference's RecurrentGradientMachine generation mode
-(gradientmachines/RecurrentGradientMachine.h:307 generateSequence) and
-the v2 SequenceGenerator (api/PaddleAPI.h:1025).  The KV cache is a
-static [L, B, H, P+G, dh] buffer updated with dynamic_update_slice —
-no dynamic shapes anywhere, so the loop lowers to a compiled while.
+`gpt_decode` (greedy / temperature / top-k sampling) and
+`gpt_beam_decode` (beam search): KV-cached decoding for the decoder-only
+LM (models/transformer.py) as ONE op each — prefill plus the whole
+generation loop compile into a single XLA program (lax.fori_loop), the
+TPU-first counterpart of the reference's RecurrentGradientMachine
+generation mode (gradientmachines/RecurrentGradientMachine.h:307
+generateSequence / beamSearch:309) and the v2 SequenceGenerator
+(api/PaddleAPI.h:1025).  The KV cache is a static [L, N, H, P+G, dh]
+buffer updated with dynamic_update_slice chains XLA can alias in place —
+no dynamic shapes anywhere, so the loops lower to compiled whiles; beam
+search flattens the lane dimension into the batch (N = B*K) and gathers
+lane state by parent after each top-k selection.
 """
 
 from __future__ import annotations
@@ -15,9 +19,122 @@ from __future__ import annotations
 from .registry import register_op
 
 
+def _lm_fns(ins, nh: int, eps: float):
+    """Shared forward machinery over the gpt_decode parameter lists.
+
+    The batch dimension is whatever `x` carries — the beam op flattens
+    B*K lanes into it and everything below is agnostic to that."""
+    import jax
+    import jax.numpy as jnp
+    from types import SimpleNamespace
+
+    emb = ins["Emb"][0]
+    pos = ins["Pos"][0]
+    L = len(ins["WQ"])
+    D = emb.shape[1]
+    dh = D // nh
+    scale = 1.0 / (dh ** 0.5)
+    cdt = emb.dtype  # compute dtype follows the parameters
+
+    def ln(x, s, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+    def heads(x):  # [N,t,D] -> [N,nh,t,dh]
+        return x.reshape(x.shape[0], -1, nh, dh).transpose(0, 2, 1, 3)
+
+    def merge(x):  # [N,nh,t,dh] -> [N,t,D]
+        return x.transpose(0, 2, 1, 3).reshape(x.shape[0], -1, D)
+
+    def block(i, x, attend):
+        """One decoder block; `attend` maps (q,k,v) heads to context."""
+        h = ln(x, ins["Ln1S"][i], ins["Ln1B"][i])
+        q = heads(h @ ins["WQ"][i])
+        k = heads(h @ ins["WK"][i])
+        v = heads(h @ ins["WV"][i])
+        a = merge(attend(i, q, k, v)) @ ins["WO"][i]
+        x = x + a
+        h = ln(x, ins["Ln2S"][i], ins["Ln2B"][i])
+        m = jax.nn.gelu(h @ ins["W1"][i] + ins["B1"][i])
+        return x + (m @ ins["W2"][i] + ins["B2"][i])
+
+    def head_logits(x):
+        """Final LN + LM head on the LAST position, in f32: [N,t,D] ->
+        [N,V]."""
+        x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
+        return (x[:, -1].astype(jnp.float32) @
+                ins["WHead"][0].astype(jnp.float32))
+
+    def prefill(tokens, T):
+        """Causal self-attention over the prompt, caching K/V into the
+        first P slots of [L,N,nh,T,dh] buffers.  Returns (last-position
+        f32 logits [N,V], kcache, vcache)."""
+        N, P = tokens.shape
+        caches = {"k": jnp.zeros((L, N, nh, T, dh), cdt),
+                  "v": jnp.zeros((L, N, nh, T, dh), cdt)}
+        causal = jnp.tril(jnp.ones((P, P), bool))
+
+        def attend(i, q, k, v):
+            caches["k"] = caches["k"].at[i, :, :, :P].set(k)
+            caches["v"] = caches["v"].at[i, :, :, :P].set(v)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * scale
+            s = jnp.where(causal, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+        x = emb[tokens] + pos[:P].astype(cdt)
+        for i in range(L):
+            x = block(i, x, attend)
+        return head_logits(x), caches["k"], caches["v"]
+
+    def decode_step(cur, kc, vc, write_at, T):
+        """One cached decode step: embed `cur` [N] at absolute position
+        `write_at` (traced), update the caches there, return (f32 logits
+        [N,V], kc, vc)."""
+        xt = emb[cur][:, None, :] + jax.lax.dynamic_slice_in_dim(
+            pos, write_at, 1, 0).astype(cdt)  # [N,1,D]
+        pos_ids = jnp.arange(T)
+        # the caches thread through the layer walk as the CARRIED arrays
+        # (dynamic_update_slice chains XLA can alias in place) — stacking
+        # per-layer copies back together would materialize a second full
+        # KV cache every step (r4 review)
+        hold = {"k": kc, "v": vc}
+
+        def attend(i, q, k, v):
+            hold["k"] = jax.lax.dynamic_update_slice(
+                hold["k"], k[None], (i, 0, 0, write_at, 0))
+            hold["v"] = jax.lax.dynamic_update_slice(
+                hold["v"], v[None], (i, 0, 0, write_at, 0))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, hold["k"][i]).astype(
+                jnp.float32) * scale
+            s = jnp.where(pos_ids[None, None, None, :] <= write_at,
+                          s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, hold["v"][i])
+
+        x = xt
+        for i in range(L):
+            x = block(i, x, attend)
+        return head_logits(x), hold["k"], hold["v"]
+
+    return SimpleNamespace(prefill=prefill, decode_step=decode_step,
+                           L=L, D=D, dh=dh, pos=pos)
+
+
+def _prompt_2d(ins):
+    import jax.numpy as jnp
+
+    tokens = ins["Tokens"][0]
+    if tokens.ndim == 3:
+        tokens = tokens[:, :, 0]
+    return tokens.astype(jnp.int32)
+
+
 @register_op("gpt_decode", grad=None)
 def gpt_decode(ctx, ins, attrs):
-    """Greedy KV-cached generation.
+    """Greedy / sampled KV-cached generation.
 
     Inputs: Tokens [B,P,1] int64 prompt; Emb [V,D]; Pos [max_len,D];
     per-layer lists (length L): Ln1S/Ln1B [D], WQ/WK/WV/WO [D,D],
@@ -54,101 +171,19 @@ def gpt_decode(ctx, ins, attrs):
         key = jax.random.fold_in(base_key, t)
         return jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
 
-    tokens = ins["Tokens"][0]
-    if tokens.ndim == 3:
-        tokens = tokens[:, :, 0]
-    tokens = tokens.astype(jnp.int32)
-    emb = ins["Emb"][0]
-    pos = ins["Pos"][0]
-    L = len(ins["WQ"])
+    tokens = _prompt_2d(ins)
     B, P = tokens.shape
-    D = emb.shape[1]
-    dh = D // nh
     T = P + G
-    assert pos.shape[0] >= T, (pos.shape, T)
-    cdt = emb.dtype  # compute dtype follows the parameters
+    fns = _lm_fns(ins, nh, eps)
+    assert fns.pos.shape[0] >= T, (fns.pos.shape, T)
 
-    def ln(x, s, b):
-        mu = x.mean(-1, keepdims=True)
-        var = ((x - mu) ** 2).mean(-1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
-
-    def heads(x):  # [B,t,D] -> [B,nh,t,dh]
-        return x.reshape(B, -1, nh, dh).transpose(0, 2, 1, 3)
-
-    def merge(x):  # [B,nh,t,dh] -> [B,t,D]
-        return x.transpose(0, 2, 1, 3).reshape(B, -1, D)
-
-    scale = 1.0 / (dh ** 0.5)
-
-    def block(i, x, attend):
-        """One decoder block; `attend` maps (q,k,v) heads to context."""
-        h = ln(x, ins["Ln1S"][i], ins["Ln1B"][i])
-        q = heads(h @ ins["WQ"][i])
-        k = heads(h @ ins["WK"][i])
-        v = heads(h @ ins["WV"][i])
-        a = merge(attend(i, q, k, v)) @ ins["WO"][i]
-        x = x + a
-        h = ln(x, ins["Ln2S"][i], ins["Ln2B"][i])
-        m = jax.nn.gelu(h @ ins["W1"][i] + ins["B1"][i])
-        return x + (m @ ins["W2"][i] + ins["B2"][i])
-
-    # ---- prefill: causal self-attention over the prompt, cache K/V ----
-    kc0 = jnp.zeros((L, B, nh, T, dh), cdt)
-    vc0 = jnp.zeros((L, B, nh, T, dh), cdt)
-    caches = {"k": kc0, "v": vc0}
-
-    causal = jnp.tril(jnp.ones((P, P), bool))
-
-    def prefill_attend(i, q, k, v):
-        caches["k"] = caches["k"].at[i, :, :, :P].set(k)
-        caches["v"] = caches["v"].at[i, :, :, :P].set(v)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-        s = jnp.where(causal, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
-
-    x = emb[tokens] + pos[:P].astype(cdt)
-    for i in range(L):
-        x = block(i, x, prefill_attend)
-    x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
-    logits = (x[:, -1].astype(jnp.float32) @
-              ins["WHead"][0].astype(jnp.float32))
+    logits, kcache, vcache = fns.prefill(tokens, T)
     first = pick(logits, G)  # [B]; G = a step index the loop never uses
     # (fold_in rejects negatives)
 
-    # ---- decode loop: one token per step against the cache ----------
-    kcache, vcache = caches["k"], caches["v"]
-    # positions 0..P+t are valid at step t (mask keeps shapes static)
-    pos_ids = jnp.arange(T)
-
     def step(t, carry):
         out_ids, cur, kc, vc, done = carry
-        xt = emb[cur][:, None, :] + jax.lax.dynamic_slice_in_dim(
-            pos, P + t, 1, 0).astype(cdt)  # [B,1,D]
-        # the caches thread through the layer walk as the CARRIED arrays
-        # (dynamic_update_slice chains XLA can alias in place) — stacking
-        # per-layer copies back together would materialize a second full
-        # KV cache every step (r4 review)
-        hold = {"k": kc, "v": vc}
-
-        def attend(i, q, k, v):
-            hold["k"] = jax.lax.dynamic_update_slice(
-                hold["k"], k[None], (i, 0, 0, P + t, 0))
-            hold["v"] = jax.lax.dynamic_update_slice(
-                hold["v"], v[None], (i, 0, 0, P + t, 0))
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, hold["k"][i]).astype(
-                jnp.float32) * scale
-            s = jnp.where(pos_ids[None, None, None, :] <= P + t, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            return jnp.einsum("bhqk,bhkd->bhqd", p, hold["v"][i])
-
-        x = xt
-        for i in range(L):
-            x = block(i, x, attend)
-        x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
-        logit = (x[:, 0].astype(jnp.float32) @
-                 ins["WHead"][0].astype(jnp.float32))
+        logit, kc, vc = fns.decode_step(cur, kc, vc, P + t, T)
         nxt = pick(logit, t)
         if eos >= 0:
             # once slot t's token is eos, every later token is eos — the
@@ -157,7 +192,7 @@ def gpt_decode(ctx, ins, attrs):
             done = done | (cur == eos)
             nxt = jnp.where(done, eos, nxt)
         out_ids = out_ids.at[:, t + 1].set(nxt)
-        return out_ids, nxt, hold["k"], hold["v"], done
+        return out_ids, nxt, kc, vc, done
 
     # slot 0 comes from the prefill; the loop runs G-1 steps writing slot
     # t+1 — running G steps and discarding the last forward would waste a
@@ -167,3 +202,75 @@ def gpt_decode(ctx, ins, attrs):
     out_ids, _, _, _, _ = jax.lax.fori_loop(
         0, G - 1, step, (out0, first, kcache, vcache, done0))
     return {"Ids": [out_ids.astype(jnp.int64)]}
+
+
+@register_op("gpt_beam_decode", grad=None)
+def gpt_beam_decode(ctx, ins, attrs):
+    """Beam-search KV-cached generation (reference beamSearch semantics,
+    RecurrentGradientMachine.h:309, over the modern model family).
+
+    Same inputs as gpt_decode.  Attrs: n_heads, max_gen, beam_size,
+    eos_id (-1 = no early finish; finished lanes otherwise continue with
+    forced eos at zero added log-prob, freezing their score), eps.
+    Outputs: Ids [B, K, max_gen] int64 (lanes sorted best-first) and
+    Scores [B, K] float32 (accumulated log-probs).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nh = int(attrs["n_heads"])
+    G = int(attrs["max_gen"])
+    K = int(attrs["beam_size"])
+    eos = int(attrs.get("eos_id", -1))
+    eps = float(attrs.get("eps", 1e-5))
+
+    tokens = _prompt_2d(ins)
+    B, P = tokens.shape
+    T = P + G
+    fns = _lm_fns(ins, nh, eps)
+    assert fns.pos.shape[0] >= T, (fns.pos.shape, T)
+    V = ins["WHead"][0].shape[1]
+
+    logits, kc, vc = fns.prefill(tokens, T)  # [B,V], [L,B,nh,T,dh]
+    logp0 = jax.nn.log_softmax(logits, axis=-1)
+    scores, first = jax.lax.top_k(logp0, K)  # [B,K] each
+    # lane-replicate the caches: [L,B,nh,T,dh] -> [L,B*K,nh,T,dh],
+    # lane-major within each batch row (b0k0, b0k1, ...)
+    kc = jnp.repeat(kc, K, axis=1)
+    vc = jnp.repeat(vc, K, axis=1)
+
+    def gather_lanes(a, parent):
+        """a [B,K,...] re-indexed by parent [B,K] along the lane dim."""
+        idx = parent.reshape(B, K, *([1] * (a.ndim - 2)))
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    def step(t, carry):
+        out_ids, cur, scores, kc, vc, done = carry
+        logit, kc, vc = fns.decode_step(cur.reshape(B * K), kc, vc,
+                                        P + t, T)
+        logp = jax.nn.log_softmax(logit, axis=-1).reshape(B, K, V)
+        if eos >= 0:
+            # finished lanes: only an eos continuation, at zero added
+            # log-prob — the lane's score freezes, keeping it comparable
+            eos_only = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+            done = done | (cur == eos)
+            logp = jnp.where(done[:, :, None], eos_only, logp)
+        cand = scores[:, :, None] + logp  # [B,K,V]
+        scores, idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        parent = idx // V  # [B,K]
+        tok = (idx % V).astype(jnp.int32)
+        # child lanes inherit parent state (incl. this step's cache rows)
+        out_ids = gather_lanes(out_ids, parent).at[:, :, t + 1].set(tok)
+        done = gather_lanes(done, parent)
+        flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        kc = jnp.take(kc, flat_parent, axis=1)
+        vc = jnp.take(vc, flat_parent, axis=1)
+        return out_ids, tok, scores, kc, vc, done
+
+    out0 = jnp.zeros((B, K, G), jnp.int32).at[:, :, 0].set(first)
+    done0 = jnp.zeros((B, K), bool)
+    out_ids, _, scores, _, _, _ = jax.lax.fori_loop(
+        0, G - 1, step, (out0, first, scores, kc, vc, done0))
+    # lanes are already score-sorted: top_k returns descending order
+    return {"Ids": [out_ids.astype(jnp.int64)],
+            "Scores": [scores.astype(jnp.float32)]}

@@ -219,3 +219,86 @@ def test_lm_generation_program_save_load_roundtrip(tmp_path):
     prog2, feeds, fetches = fluid.io.load_inference_model(d, exe2)
     (after,) = exe2.run(prog2, feed={feeds[0]: pr}, fetch_list=fetches)
     np.testing.assert_array_equal(np.asarray(after), np.asarray(before))
+
+
+def _seq_logprob(lg, pr, seq):
+    """Sum of per-step log-probs of `seq` [B,G] under tower logits `lg`
+    [B,P+G,V] for prompt length P = pr.shape[1]."""
+    P = pr.shape[1]
+    def lsm(z):  # stable log-softmax
+
+        z = z - z.max(-1, keepdims=True)
+        return z - np.log(np.exp(z).sum(-1, keepdims=True))
+
+    lp = lsm(lg.astype(np.float64))
+    B, G = seq.shape
+    tot = np.zeros(B)
+    for t in range(G):
+        tot += lp[np.arange(B), P + t - 1, seq[:, t]]
+    return tot
+
+
+def test_lm_beam_generate_beats_or_matches_greedy():
+    """Beam search explores K lanes: lane 0's accumulated log-prob must
+    be >= the greedy sequence's (greedy is one of the paths beam can
+    take), K=1 must EQUAL greedy, and reported scores must match the
+    tower-recomputed sequence log-probs (locks the score bookkeeping)."""
+    from paddle_tpu import layers
+
+    V, D, L, NH, P, G, K = 50, 32, 2, 2, 5, 6, 4
+    lm = transformer.DecoderLM(V, D, L, NH, max_len=P + G, dtype="float32")
+    tokens = layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    logits = lm.logits(tokens)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = layers.data("prompt", shape=[P, 1], dtype="int64")
+        greedy = lm.generate(prompt, max_gen=G)
+        beam_ids, beam_scores = lm.beam_generate(prompt, max_gen=G,
+                                                 beam_size=K)
+        beam1_ids, _ = lm.beam_generate(prompt, max_gen=G, beam_size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    B = 3
+    pr = np.random.RandomState(7).randint(0, V, (B, P, 1)).astype(np.int64)
+    g, bids, bsc, b1 = (np.asarray(v) for v in exe.run(
+        gen_prog, feed={"prompt": pr},
+        fetch_list=[greedy, beam_ids, beam_scores, beam1_ids]))
+    assert bids.shape == (B, K, G) and bsc.shape == (B, K)
+    np.testing.assert_array_equal(b1[:, 0], g)  # K=1 == greedy
+    assert (np.diff(bsc, axis=1) <= 1e-5).all()  # lanes sorted
+
+    # tower-recomputed log-probs: scores honest, lane0 >= greedy
+    def tower_lp(seq):
+        full = np.concatenate([pr, seq[:, :, None]], axis=1)
+        (lg,) = exe.run(feed={"tokens": full}, fetch_list=[logits])
+        return _seq_logprob(np.asarray(lg), pr, seq)
+
+    greedy_lp = tower_lp(g)
+    lane0_lp = tower_lp(bids[:, 0])
+    np.testing.assert_allclose(lane0_lp, bsc[:, 0], atol=1e-3)
+    assert (lane0_lp >= greedy_lp - 1e-4).all(), (lane0_lp, greedy_lp)
+
+
+def test_lm_beam_generate_eos_freezes_lanes():
+    from paddle_tpu import layers
+
+    V, P, G, K = 20, 4, 8, 3
+    lm = transformer.DecoderLM(V, 32, 1, 2, max_len=P + G, dtype="float32")
+    tokens = layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    lm.logits(tokens)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = layers.data("prompt", shape=[P, 1], dtype="int64")
+        ids, scores = lm.beam_generate(prompt, max_gen=G, beam_size=K,
+                                       eos_id=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pr = np.random.RandomState(3).randint(0, V, (2, P, 1)).astype(np.int64)
+    (gen, sc) = (np.asarray(v) for v in exe.run(
+        gen_prog, feed={"prompt": pr}, fetch_list=[ids, scores]))
+    for b in range(gen.shape[0]):
+        for k in range(K):
+            row = gen[b, k]
+            hits = np.where(row == 0)[0]
+            if hits.size:
+                assert (row[hits[0]:] == 0).all(), row
