@@ -302,3 +302,39 @@ def test_lm_beam_generate_eos_freezes_lanes():
             hits = np.where(row == 0)[0]
             if hits.size:
                 assert (row[hits[0]:] == 0).all(), row
+
+
+def test_lm_trains_on_imikolov_stream():
+    """Book-style acceptance: the LM family rides the same dataset
+    pipeline as the reference models — imikolov (PTB) gram-sequences in,
+    next-token loss down.  (Zero-egress runs use the dataset's
+    deterministic synthetic stream, whose next-token IS a function of the
+    context, so the LM can learn it.)"""
+    from paddle_tpu.dataset import imikolov
+
+    T = 16
+    rows = []
+    for tup in imikolov.train(n=256, gram=T + 1)():
+        rows.append(tup)
+        if len(rows) >= 64:
+            break
+    arr = np.asarray(rows, dtype=np.int64)
+    toks = arr[:, :T, None]
+    tgts = arr[:, 1:T + 1, None]
+    vocab = int(arr.max()) + 1
+
+    loss = transformer.build_lm_train_program(
+        seq_len=T, vocab_size=vocab, dim=64, n_layers=2, n_heads=2,
+        dtype="float32", learning_rate=3e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ls = []
+    for _ in range(60):
+        (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
+                        fetch_list=[loss])
+        ls.append(float(np.asarray(lv)))
+    # the 0.55 bar was validated on the deterministic synthetic stream;
+    # a cache-bearing machine serves real PTB, where 60 steps on this
+    # tiny model only warrant "clearly decreasing"
+    bar = 0.55 if imikolov.DATA_MODE.get("imikolov") == "synthetic" else 0.9
+    assert ls[-1] < ls[0] * bar, (ls[0], ls[-1], bar)
