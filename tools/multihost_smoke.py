@@ -70,6 +70,30 @@ def child(pid: int, n: int, coordinator: str):
     for _ in range(STEPS):
         (l,) = pe.run(feed={"x": xs, "y": ys}, fetch_list=[avg])
         losses.append(float(np.asarray(l).reshape(())))
+
+    # phase 2: the transformer LM with dp x sp across the SAME two
+    # processes — the sequence axis (zigzag causal flash ring's
+    # ppermute neighbors) now crosses a process boundary, the collective
+    # topology a TPU pod slice presents that single-process meshes can't
+    from paddle_tpu.models import transformer
+
+    fluid.reset()
+    sp = 2
+    lm_loss = transformer.build_lm_train_program(
+        seq_len=64, vocab_size=128, dim=64, n_layers=1, n_heads=2,
+        dtype="float32", learning_rate=1e-2)
+    # sp MAJOR: devices are process-contiguous, so a minor sp axis would
+    # pair ring neighbors within one process and never cross the
+    # boundary this smoke exists to exercise — sp-major makes each sp
+    # partner live in the OTHER process (r4 review)
+    pe2 = ParallelExecutor(axes={"sp": sp, "dp": world // sp})
+    pe2.run(fluid.default_startup_program())
+    toks = rng.randint(0, 128, (world, 64, 1)).astype(np.int64)
+    for _ in range(STEPS):
+        (l2,) = pe2.run(feed={"tokens": toks,
+                              "targets": np.roll(toks, -1, axis=1)},
+                        fetch_list=[lm_loss])
+        losses.append(float(np.asarray(l2).reshape(())))
     print("LOSSES " + json.dumps(losses), flush=True)
 
 
@@ -132,7 +156,11 @@ def main(attempt: int = 0):
             math.isfinite(a) and abs(a - b) < 1e-5
             for a, b in zip(outs[0], other)
         ), f"processes disagree: {outs}"
-    assert outs[0][-1] < outs[0][0], f"no training progress: {outs[0]}"
+    # losses hold two phases (dp MLP, then dp x sp LM) of STEPS each —
+    # progress is judged within each phase, not across the boundary
+    mlp, lm = outs[0][:STEPS], outs[0][STEPS:]
+    assert mlp[-1] < mlp[0], f"no dp progress: {mlp}"
+    assert lm and lm[-1] < lm[0], f"no dp x sp LM progress: {lm}"
     print(f"MULTIHOST SMOKE OK trainers={n} losses={outs[0]}")
 
 
