@@ -338,3 +338,27 @@ def test_lm_trains_on_imikolov_stream():
     # tiny model only warrant "clearly decreasing"
     bar = 0.55 if imikolov.DATA_MODE.get("imikolov") == "synthetic" else 0.9
     assert ls[-1] < ls[0] * bar, (ls[0], ls[-1], bar)
+
+
+def test_lm_generate_bf16_tower():
+    """Generation runs off a bfloat16 tower (compute dtype follows the
+    parameters; head in f32): shapes, finiteness, and eos typing hold."""
+    from paddle_tpu import layers
+
+    V, P, G = 30, 4, 5
+    lm = transformer.DecoderLM(V, 32, 1, 2, max_len=P + G,
+                               dtype="bfloat16")
+    tokens = layers.data("tokens", shape=[P + G, 1], dtype="int64")
+    lm.logits(tokens)
+    gen_prog = fluid.Program()
+    with fluid.program_guard(gen_prog):
+        prompt = layers.data("prompt", shape=[P, 1], dtype="int64")
+        ids = lm.generate(prompt, max_gen=G)
+        bids, bsc = lm.beam_generate(prompt, max_gen=G, beam_size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pr = np.random.RandomState(4).randint(0, V, (2, P, 1)).astype(np.int64)
+    g, bi, bs = (np.asarray(v) for v in exe.run(
+        gen_prog, feed={"prompt": pr}, fetch_list=[ids, bids, bsc]))
+    assert g.shape == (2, G) and bi.shape == (2, 2, G)
+    assert ((0 <= g) & (g < V)).all() and np.isfinite(bs).all()
