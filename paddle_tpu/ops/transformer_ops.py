@@ -66,18 +66,32 @@ def _lm_fns(ins, nh: int, eps: float):
         return (x[:, -1].astype(jnp.float32) @
                 ins["WHead"][0].astype(jnp.float32))
 
-    def prefill(tokens, T):
+    def prefill(tokens, T, use_flash=False, flash_interpret=False):
         """Causal self-attention over the prompt, caching K/V into the
         first P slots of [L,N,nh,T,dh] buffers.  Returns (last-position
-        f32 logits [N,V], kcache, vcache)."""
+        f32 logits [N,V], kcache, vcache).
+
+        use_flash routes the prompt pass through the Pallas flash kernel
+        — the dense path materializes [N,nh,P,P] f32 scores (4.3 GB at
+        P=4096 bs8 h8), which for long prompts is exactly the buffer
+        flash exists to eliminate."""
         N, P = tokens.shape
         caches = {"k": jnp.zeros((L, N, nh, T, dh), cdt),
                   "v": jnp.zeros((L, N, nh, T, dh), cdt)}
-        causal = jnp.tril(jnp.ones((P, P), bool))
+        if not use_flash:
+            # dense path only: this [P,P] mask is the buffer the flash
+            # branch exists to avoid materializing
+            causal = jnp.tril(jnp.ones((P, P), bool))
 
         def attend(i, q, k, v):
             caches["k"] = caches["k"].at[i, :, :, :P].set(k)
             caches["v"] = caches["v"].at[i, :, :, :P].set(v)
+            if use_flash:
+                from .pallas_kernels.flash_attention import flash_attention
+
+                # [N,nh,P,dh] is the kernel's [B,H,T,D] layout already
+                return flash_attention(q, k, v, causal=True, scale=scale,
+                                       interpret=flash_interpret)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
                 jnp.float32) * scale
             s = jnp.where(causal, s, -1e30)
@@ -121,6 +135,20 @@ def _lm_fns(ins, nh: int, eps: float):
 
     return SimpleNamespace(prefill=prefill, decode_step=decode_step,
                            L=L, D=D, dh=dh, pos=pos)
+
+
+def _flash_ok(ctx, P: int, fns) -> bool:
+    """Prompt-prefill flash gate: the shared Pallas dispatch conditions
+    plus the kernel's shape contract (lane-width head dim, a prompt long
+    enough to tile)."""
+    from .pallas_kernels._common import pallas_dispatch_ok
+
+    # same shape contract as the training-side flash gate
+    # (attention_ops.py single-chip dispatch): 128-tiled sequence, lane-
+    # width head dim — a near-miss P would snap to a tile shape Mosaic
+    # rejects and the runtime fallback would then disable EVERY fused
+    # kernel process-wide
+    return pallas_dispatch_ok(ctx) and fns.dh <= 128 and P % 128 == 0
 
 
 def _prompt_2d(ins):
@@ -177,7 +205,8 @@ def gpt_decode(ctx, ins, attrs):
     fns = _lm_fns(ins, nh, eps)
     assert fns.pos.shape[0] >= T, (fns.pos.shape, T)
 
-    logits, kcache, vcache = fns.prefill(tokens, T)
+    logits, kcache, vcache = fns.prefill(tokens, T,
+                                         use_flash=_flash_ok(ctx, P, fns))
     first = pick(logits, G)  # [B]; G = a step index the loop never uses
     # (fold_in rejects negatives)
 
@@ -231,7 +260,8 @@ def gpt_beam_decode(ctx, ins, attrs):
     assert fns.pos.shape[0] >= T, (fns.pos.shape, T)
     V = ins["WHead"][0].shape[1]
 
-    logits, kc, vc = fns.prefill(tokens, T)  # [B,V], [L,B,nh,T,dh]
+    logits, kc, vc = fns.prefill(
+        tokens, T, use_flash=_flash_ok(ctx, P, fns))  # [B,V] + caches
     logp0 = jax.nn.log_softmax(logits, axis=-1)
     scores, first = jax.lax.top_k(logp0, K)  # [B,K] each
     # lane-replicate the caches: [L,B,nh,T,dh] -> [L,B*K,nh,T,dh],

@@ -362,3 +362,39 @@ def test_lm_generate_bf16_tower():
         gen_prog, feed={"prompt": pr}, fetch_list=[ids, bids, bsc]))
     assert g.shape == (2, G) and bi.shape == (2, 2, G)
     assert ((0 <= g) & (g < V)).all() and np.isfinite(bs).all()
+
+
+def test_lm_prefill_flash_matches_dense():
+    """The flash prefill branch (interpret mode) must reproduce the dense
+    prefill bit-for-bit in logits AND caches — off-TPU the branch is
+    unreachable through the op layer, so this drives _lm_fns directly."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import transformer_ops as tf_ops
+
+    rng = np.random.RandomState(0)
+    V, D, L, NH, P, G = 30, 32, 2, 2, 128, 4
+    mk = lambda *shape: jnp.asarray((rng.randn(*shape) * 0.1)
+                                    .astype(np.float32))
+    ins = {"Emb": [mk(V, D)], "Pos": [mk(P + G, D)],
+           "LnfS": [mk(D) + 1.0], "LnfB": [mk(D)], "WHead": [mk(D, V)]}
+    for slot in ("Ln1S", "Ln1B", "Ln2S", "Ln2B"):
+        ins[slot] = [mk(D) + (1.0 if slot.endswith("S") else 0.0)
+                     for _ in range(L)]
+    for slot in ("WQ", "WK", "WV", "WO"):
+        ins[slot] = [mk(D, D) for _ in range(L)]
+    ins["W1"] = [mk(D, 4 * D) for _ in range(L)]
+    ins["B1"] = [mk(4 * D) for _ in range(L)]
+    ins["W2"] = [mk(4 * D, D) for _ in range(L)]
+    ins["B2"] = [mk(D) for _ in range(L)]
+
+    fns = tf_ops._lm_fns(ins, NH, 1e-5)
+    toks = jnp.asarray(rng.randint(0, V, (2, P)).astype(np.int32))
+    lg_d, kc_d, vc_d = fns.prefill(toks, P + G)
+    lg_f, kc_f, vc_f = fns.prefill(toks, P + G, use_flash=True,
+                                   flash_interpret=True)
+    np.testing.assert_allclose(np.asarray(lg_f), np.asarray(lg_d),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(kc_f), np.asarray(kc_d),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vc_f), np.asarray(vc_d),
+                               atol=1e-6)
