@@ -46,10 +46,7 @@ class HostEmbedding:
         """Rows for (possibly repeated) ids, shape [len(ids), dim]."""
         ids = np.asarray(ids).reshape(-1)
         uniq, inverse = np.unique(ids, return_inverse=True)
-        if isinstance(self.backend, ParameterServerService):
-            rows = self.backend.get_param_rows(self.name, uniq)
-        else:
-            rows = self.backend.get_param_rows(self.name, uniq)
+        rows = self.backend.get_param_rows(self.name, uniq)
         return rows[inverse]
 
     def push_grad(self, ids: np.ndarray, grads: np.ndarray):

@@ -204,10 +204,17 @@ class Executor:
         block = program.blocks[block_id]
         feed_vals = self._prepare_feeds(block, feed)
         key = self._cache_key(program, block_id, feed_vals, fetch_names)
+        load_sig = self._load_file_sig(program)
         entry = self._cache.get(key)
-        if entry is None:
+        if entry is None or entry[0] != load_sig:
+            # same staleness contract as run(): a rewritten load file means
+            # the cached trace no longer matches what run() would execute
             compiled = self._compile(program, block_id, feed_vals,
                                      fetch_names)
+            # store under run()'s (load_sig, compiled) contract so a later
+            # run() — or a repeat optimized_hlo() before any run — reuses
+            # this trace instead of paying a full retrace (ADVICE r4)
+            self._cache[key] = (load_sig, compiled)
         else:
             compiled = entry[1]
         state_w = {n: scope.find(n) for n in compiled.rw_state}

@@ -5,6 +5,15 @@ read/write it imperatively.  Here the Scope only holds *persistent* state
 between executor runs — parameters, optimizer moments, learning-rate tensors,
 metric states — as JAX arrays resident on the place's device.  Transient op
 outputs never materialize: they are values inside the compiled XLA program.
+
+Device-promotion contract: a numpy array written into the scope (set_value,
+load paths, fuse_batch_norm's folded filters) is promoted IN PLACE to a
+jax.Array device buffer on the first Executor.run that reads it
+(executor._pin_host_array) — re-staging host memory every step costs ~80x
+over a tunneled backend.  Consequences: (a) `find()` may return jax.Array
+where numpy was written; readers needing numpy use `find_np()`; (b) holding
+the original numpy object for later in-place mutation is unsupported — the
+scope no longer references it after the first run; write via `set()`.
 """
 
 from __future__ import annotations

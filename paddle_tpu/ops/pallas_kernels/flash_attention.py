@@ -26,14 +26,43 @@ from __future__ import annotations
 import functools
 
 
-def _snap_block(block: int, T: int) -> int:
-    """Largest divisor of T that is <= block: the requested block size is a
-    performance hint, never a shape constraint (a seq len of 1536 must not
-    fail the bk=1024 default — it runs at bk=768)."""
-    b = min(block, T)
-    while T % b:
-        b -= 1
-    return b
+def _snap_block(block: int, T: int, tile: int = 128) -> int:
+    """Largest divisor of T that is <= block AND a multiple of `tile` — the
+    requested block size is a performance hint, never a shape constraint
+    (a seq len of 1536 must not fail the bk=1024 default — it runs at
+    bk=768).  The tile floor enforces the (8,128)-divisible Mosaic block
+    contract for every dtype the kernels accept: an unaligned divisor
+    (ADVICE r4: T=10880 snapped block_q=512 to 340) would pass tracing,
+    fail Mosaic at execution, and runtime_disable would then black out ALL
+    fused kernels process-wide.  Returns 0 when no aligned divisor exists;
+    callers raise at trace time, and the dispatch gates (T % 128 == 0 with
+    default blocks >= 128) never reach that case."""
+    b = (min(block, T) // tile) * tile
+    while b and T % b:
+        b -= tile
+    if b:
+        return b
+    # whole-dimension block: Mosaic accepts block dims EQUAL to the
+    # array's (the "or equal" arm of the tile contract) — the path ring
+    # attention's zigzag short chunks (t2 <= 128) rely on
+    return T if T <= block else 0
+
+
+def _snap_blocks(block_q: int, block_k: int, T: int,
+                 interpret: bool = False):
+    """Aligned (bq, bk) for the public kernel entry points, failing with a
+    clear Python error at trace time instead of a Mosaic one at run time.
+    Interpret mode has no Mosaic tile contract (tests run tiny T/blocks
+    there), so it keeps plain largest-divisor snapping."""
+    tile = 1 if interpret else 128
+    bq = _snap_block(block_q, T, tile)
+    bk = _snap_block(block_k, T, tile)
+    if not bq or not bk:
+        raise ValueError(
+            f"flash attention needs a 128-aligned divisor of T={T} at or "
+            f"under block_q={block_q}/block_k={block_k}; use the dense "
+            f"path for this shape")
+    return bq, bk
 
 
 def _causal_kv_idx(bq: int, bk: int):
@@ -166,8 +195,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     """q,k,v [B,H,T,D] → [B,H,T,D]. block_q/block_k are performance hints,
     snapped down to divisors of T; D ≤ 128 recommended (one lane tile)."""
     B, H, T, D = q.shape
-    bq = _snap_block(block_q, T)
-    bk = _snap_block(block_k, T)
+    bq, bk = _snap_blocks(block_q, block_k, T, interpret)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
 
     qf = q.reshape(B * H, T, D)
@@ -294,7 +322,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=512,
                         block_k=1024, interpret=False):
     """Forward that also returns the per-row logsumexp (backward residual)."""
     B, H, T, D = q.shape
-    bq, bk = _snap_block(block_q, T), _snap_block(block_k, T)
+    bq, bk = _snap_blocks(block_q, block_k, T, interpret)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
     qf, kf, vf = (a.reshape(B * H, T, D) for a in (q, k, v))
     out, lse = _fwd_grid(B, H, T, D, bq, bk, causal, True, q.dtype,
@@ -310,7 +338,7 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
-    bq, bk = _snap_block(block_q, T), _snap_block(block_k, T)
+    bq, bk = _snap_blocks(block_q, block_k, T, interpret)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
     qf, kf, vf, of, dof = (a.reshape(B * H, T, D)
                            for a in (q, k, v, o, do))

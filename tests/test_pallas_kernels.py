@@ -31,7 +31,20 @@ def test_flash_attention_snaps_non_dividing_blocks():
 
     assert _snap_block(512, 1536) == 512
     assert _snap_block(1024, 1536) == 768
-    assert _snap_block(16, 60) == 15
+    assert _snap_block(16, 60, tile=1) == 15  # interpret mode: no tile floor
+    # ADVICE r4 (medium): on hardware the snapped block must satisfy the
+    # (8,128) Mosaic tile contract — T=10880 must NOT snap 512 to 340 (a
+    # divisor, but misaligned: Mosaic compile failure at execution time
+    # that runtime_disable would turn into a process-wide kernel blackout)
+    assert _snap_block(512, 10880) == 128
+    assert _snap_block(512, 10880) % 128 == 0
+    assert _snap_block(512, 96) == 96  # whole-dim block: "equal to array" arm
+    assert _snap_block(512, 64) == 64  # zigzag short half-chunks path
+    assert _snap_block(128, 200) == 0  # T > block, no aligned divisor
+    with pytest.raises(ValueError, match="128-aligned"):
+        from paddle_tpu.ops.pallas_kernels.flash_attention import \
+            _snap_blocks
+        _snap_blocks(128, 128, 200)
     rng = np.random.RandomState(1)
     B, H, T, D = 1, 2, 96, 16
     q = rng.randn(B, H, T, D).astype(np.float32)

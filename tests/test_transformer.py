@@ -133,8 +133,15 @@ def test_lm_generate_kv_cache_matches_tower():
     (lg,) = exe.run(feed={"tokens": full}, fetch_list=[logits])
     lg = np.asarray(lg)
     for t in range(G):
-        expect = lg[:, P + t - 1].argmax(-1)
-        np.testing.assert_array_equal(gen[:, t], expect)
+        # tolerance-aware parity (ADVICE r4): the fused decode op and the
+        # training tower are numerically different f32 computation orders,
+        # so a near-tie in logits may legitimately flip the argmax — the
+        # generated token's tower logit must be within eps of the tower's
+        # best, not literally equal to its argmax
+        step = lg[:, P + t - 1]  # [B, V]
+        chosen = step[np.arange(B), gen[:, t]]
+        assert np.all(chosen >= step.max(-1) - 1e-4), (
+            t, chosen, step.max(-1))
 
 
 def test_lm_generate_eos_padding():

@@ -250,7 +250,8 @@ def test_v1_extra_evaluators(capfd):
     feed = {"x": rng.rand(6, 4).astype(np.float32),
             "y": rng.randint(0, 3, (6, 1)).astype(np.int64)}
     out = exe.run(feed=feed, fetch_list=[s, cs, loss])
-    np.testing.assert_allclose(float(np.asarray(out[0])), 6.0, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out[0]).ravel()[0], 6.0,
+                               rtol=1e-4)
     assert np.asarray(out[1]).shape == (3,)
     np.testing.assert_allclose(np.asarray(out[1]).sum(), 6.0, rtol=1e-4)
     captured = capfd.readouterr()

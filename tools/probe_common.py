@@ -16,7 +16,12 @@ import time
 
 # One source of truth for the daemon<->bench handshake locations: a rename
 # applied to only one side would silently break the stand-down protocol.
-EVIDENCE_DIR_DEFAULT = "BENCH_attempts_r04"
+EVIDENCE_DIR_DEFAULT = "BENCH_attempts_r05"
+
+# Prior rounds' evidence dirs, newest first — bench.py's cached_onchip
+# fallback (VERDICT r4 Missing #1) searches these after the current dir so
+# a tunnel-down round still reports the best-known on-chip numbers.
+EVIDENCE_DIR_HISTORY = (EVIDENCE_DIR_DEFAULT, "BENCH_attempts_r04")
 
 
 def evidence_dir(repo_root):
@@ -51,6 +56,129 @@ def json_lines(text):
             except ValueError:
                 pass
     return out
+
+
+def _classify_metric(name):
+    """Bench-mode kind for a result's metric name, or None for rows the
+    cached fallback should not surface (microbench rows, error stubs)."""
+    if "_train_img_per_s" in name:
+        return name.split("_", 1)[0].rstrip("0123456789")
+    if "_infer_img_per_s" in name:
+        return "infer"
+    if name.startswith("lstm"):
+        return "lstm"
+    if name.startswith("gpt") and "_train_" in name:
+        return "gpt"
+    if name.startswith("gpt") and "_decode_" in name:
+        return "gpt_gen"
+    return None
+
+
+# The default-suite anchor configs per kind: sweep/A-B captures (bs256,
+# NCHW, remat, no-bnfold...) must not displace the comparable-across-rounds
+# headline row just by being newer.  A row matching its kind's anchor
+# substrings (and none of the exclusions) outranks any non-anchor row.
+_ANCHOR_CONFIGS = {
+    "resnet": (("_bs128_", "_nhwc"), ("_remat", "_bnfuse", "nchw")),
+    "lstm": (("_bs64",), ()),
+    "infer": (("_bs16", "_bnfold"), ()),
+    "gpt": (("_seq1024",), ("_remat",)),
+    "gpt_gen": (("_p64_g192",), ()),
+}
+
+
+def _is_anchor(kind, metric):
+    inc, exc = _ANCHOR_CONFIGS.get(kind, ((), ()))
+    m = metric + "_"  # so a trailing "_bs64" matches "_bs64_"-style probes
+    return (all(s in m for s in inc) and not any(s in m for s in exc))
+
+
+def _artifact_utc(body_utc, path, mtime):
+    """Capture timestamp for ranking: the artifact's embedded captured_utc
+    first, else a YYYYmmdd[_HHMM[SS]] stamp in the filename (committed
+    JSONL files keep it across clones), else file mtime (which a fresh
+    checkout fabricates — last resort only)."""
+    import re
+
+    if body_utc:
+        return body_utc
+    m = re.search(r"(20\d{6})[_-](\d{4,6})", os.path.basename(path))
+    if m:
+        d, t = m.group(1), m.group(2).ljust(6, "0")
+        return (f"{d[:4]}-{d[4:6]}-{d[6:8]}T"
+                f"{t[:2]}:{t[2:4]}:{t[4:6]}Z")
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+
+
+def load_cached_onchip(repo_root):
+    """Best-known daemon-captured on-chip results, newest first per mode
+    (VERDICT r4 Missing #1: the official bench artifact must never be an
+    error-only object when real numbers exist in the repo record).
+
+    Scans the evidence dirs (current round first, then prior rounds) for
+    capture artifacts — {"captured_utc": ..., "results": [headline lines]}
+    as written by tools/evidence_daemon.run_capture — and returns
+    {kind: result_dict} where each result carries provenance fields:
+    "provenance": "cached_onchip", "cached_artifact", "captured_utc".
+    Error rows and zero-value rows are never cached.
+    """
+    import glob
+    import json
+
+    best = {}  # kind -> ((is_anchor, captured_utc), result)
+    for d in EVIDENCE_DIR_HISTORY:
+        for path in sorted(glob.glob(os.path.join(repo_root, d, "*.json"))):
+            try:
+                with open(path) as f:
+                    text = f.read()
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            body_utc, rows = "", []
+            try:
+                body = json.loads(text)
+            except ValueError:
+                body = None
+            if isinstance(body, dict):
+                body_utc = body.get("captured_utc", "")
+                rows = body.get("results") or []
+                if not isinstance(rows, list):
+                    rows = []
+                if not rows and "metric" in body:
+                    # a single-line hand-run capture parses as a dict with
+                    # no "results": the dict itself is the headline row
+                    rows = [body]
+            else:
+                # raw JSONL capture (hand-run bench sessions): one headline
+                # object per line
+                rows = json_lines(text)
+            utc = _artifact_utc(body_utc, path, mtime)
+            flat = []
+            for r in rows:
+                if not isinstance(r, dict):
+                    continue
+                flat.append(r)
+                flat.extend(x for x in r.get("extra_metrics", [])
+                            if isinstance(x, dict))
+            for r in flat:
+                metric = str(r.get("metric", ""))
+                kind = _classify_metric(metric)
+                if kind is None or r.get("unit") == "error" \
+                        or not r.get("value") \
+                        or r.get("provenance") == "cached_onchip":
+                    # never re-ingest a prior fallback emission: it would
+                    # launder stale numbers under a fresh artifact's stamp
+                    continue
+                rank = (_is_anchor(kind, metric), utc)
+                if kind in best and best[kind][0] >= rank:
+                    continue
+                cached = {k: v for k, v in r.items()
+                          if k not in ("extra_metrics", "preflight_probes")}
+                cached["provenance"] = "cached_onchip"
+                cached["cached_artifact"] = os.path.relpath(path, repo_root)
+                cached["captured_utc"] = utc
+                best[kind] = (rank, cached)
+    return {k: v for k, (_, v) in best.items()}
 
 
 def probe_once(timeout, env=None):
