@@ -37,6 +37,12 @@ def _snap_block(block: int, T: int, tile: int = 128) -> int:
     fused kernels process-wide.  Returns 0 when no aligned divisor exists;
     callers raise at trace time, and the dispatch gates (T % 128 == 0 with
     default blocks >= 128) never reach that case."""
+    # the 128 floor is deliberately stricter than the (8,128) sublane
+    # contract alone: bq also becomes a LANE-dim dynamic-slice offset in
+    # the (1,1,T) lse row blocks (pl.ds(qi*bq, bq)), and non-128-aligned
+    # lane slices are the r4 "bf16 mask slice" Mosaic failure class — a
+    # sublane-only floor (8/16/32) would trade a few grid iterations for
+    # that crash on the training path
     b = (min(block, T) // tile) * tile
     while b and T % b:
         b -= tile

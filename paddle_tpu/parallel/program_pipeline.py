@@ -289,15 +289,25 @@ class ProgramPipeline:
 
         batch = next(iter(feed_shapes.values()))[0]
         micro_bs = batch // self.n_micro
-        act_len = self._interface_len(micro_bs)
-        stage_fns = [self._stage_fn(s, micro_bs, act_len)
+        # pp×dp composition: a 'dp' mesh axis splits every microbatch
+        # across dp members — same pipeline schedule per slice, gradients
+        # psum'd over dp by autodiff through the pmean'd loss
+        dp = dict(zip(self.mesh.axis_names,
+                      self.mesh.devices.shape)).get("dp", 1)
+        if micro_bs % dp:
+            raise ValueError(
+                f"microbatch {micro_bs} not divisible by dp={dp}")
+        local_bs = micro_bs // dp
+        act_len = self._interface_len(local_bs)
+        stage_fns = [self._stage_fn(s, local_bs, act_len)
                      for s in range(self.pp)]
         n_micro, pp = self.n_micro, self.pp
         fwd_perm = [(s, s + 1) for s in range(pp - 1)]
         shard_map = get_shard_map()
+        feeds_spec = P(None, "dp") if dp > 1 else P()
 
         @partial(shard_map, mesh=self.mesh,
-                 in_specs=(P("pp"), P(), P()),
+                 in_specs=(P("pp"), feeds_spec, P()),
                  out_specs=P(), check_vma=False)
         def forward_loss(packed_local, feeds_micro, key):
             flat = packed_local[0]  # shard_map keeps a length-1 pp dim
@@ -327,8 +337,13 @@ class ProgramPipeline:
             buf0 = jnp.zeros((act_len,), jnp.float32)
             (buf, losses), _ = lax.scan(
                 tick, (buf0, jnp.zeros((n_micro,))), jnp.arange(ticks))
-            # only the last stage accumulated losses; share them
-            return lax.psum(losses, "pp").mean()
+            # only the last stage accumulated losses; share them.  Under
+            # pp×dp each member saw its local_bs slice: pmean over dp
+            # gives the global batch mean (its VJP psums the dp grads)
+            loss = lax.psum(losses, "pp").mean()
+            if dp > 1:
+                loss = lax.pmean(loss, "dp")
+            return loss
 
         def train_step(packed, velocity, feeds_micro, key):
             loss, g = jax.value_and_grad(

@@ -500,3 +500,108 @@ def test_remat_composes_with_parallel_executor():
     plain = losses(False)
     remat = losses(True)
     np.testing.assert_allclose(remat, plain, rtol=1e-3)
+
+
+def test_embedding_mp_sharded_matches_replicated():
+    """Vocab-sharded (mp) on-device embedding TRAINING equals the
+    replicated single-device run — losses per step and the final table
+    (the reference's test_CompareSparse dense==sparse equivalence
+    contract, gserver/tests/test_CompareSparse.cpp, applied to the
+    SPMD path: lookup_table gather and its scatter-add gradient must
+    be exact under a vocab-sharded table)."""
+    V, D, steps = 256, 32, 4
+
+    def build():
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[V, D])
+        logits = fluid.layers.fc(input=emb, size=8)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(7)
+    feeds = [
+        {"ids": rng.randint(0, V, (16, 1)).astype(np.int64),
+         "label": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+        for _ in range(steps)
+    ]
+
+    loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    single = [float(np.asarray(exe.run(feed=f, fetch_list=[loss])[0]))
+              for f in feeds]
+    table_single = fluid.global_scope().find_np("embedding_0.w_0").copy()
+
+    fluid.reset_global_scope()
+    pe = ParallelExecutor(axes={"dp": 2, "mp": 4})
+    pe.run(fluid.default_startup_program())
+    multi = [float(np.asarray(pe.run(feed=f, fetch_list=[loss])[0]))
+             for f in feeds]
+    w = fluid.global_scope().find("embedding_0.w_0")
+    assert tuple(w.sharding.spec) == ("mp", None), w.sharding.spec
+    table_multi = np.asarray(w)
+
+    np.testing.assert_allclose(single, multi, rtol=2e-4)
+    np.testing.assert_allclose(table_single, table_multi,
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_program_pipeline_composes_with_dp():
+    """pp×dp composition (VERDICT r4 Next #9): the same Program pipelined
+    over a {'pp': 2, 'dp': 2} mesh — microbatches split across dp, grads
+    psum'd through the pmean'd loss — matches the single-device Executor
+    step-for-step with n_micro=1 (where GPipe is plain SGD)."""
+    from paddle_tpu.parallel import ProgramPipeline, make_mesh
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="tanh")
+        fluid.layers.pipeline_stage()
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        return loss
+
+    rng = np.random.RandomState(2)
+    xs = rng.rand(8, 8).astype(np.float32)
+    ys = rng.rand(8, 1).astype(np.float32)
+
+    fluid.default_startup_program().random_seed = 13
+    loss = build()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    init = {n: np.asarray(fluid.global_scope().find_np(n))
+            for n in fluid.global_scope().local_names()}
+    ref = [float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+           for _ in range(4)]
+
+    fluid.reset()
+    fluid.default_startup_program().random_seed = 13
+    loss = build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())
+    for n, v in init.items():
+        fluid.global_scope().set(n, v)
+    mesh = make_mesh({"pp": 2, "dp": 2})
+    pipe = ProgramPipeline(fluid.default_main_program(), loss, mesh,
+                           n_micro=1, optimizer=("sgd", 0.1))
+    pipe.initialize()
+    got = [pipe.run({"x": xs, "y": ys}) for _ in range(4)]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    # multi-microbatch pp×dp still trains (schedule + dp split compose)
+    fluid.reset()
+    fluid.default_startup_program().random_seed = 13
+    loss = build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    pipe2 = ProgramPipeline(fluid.default_main_program(), loss,
+                            make_mesh({"pp": 2, "dp": 2}), n_micro=2,
+                            optimizer=("sgd", 0.1))
+    pipe2.initialize()
+    seq = [pipe2.run({"x": xs, "y": ys}) for _ in range(6)]
+    assert seq[-1] < seq[0]

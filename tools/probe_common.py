@@ -80,10 +80,10 @@ def _classify_metric(name):
 # substrings (and none of the exclusions) outranks any non-anchor row.
 _ANCHOR_CONFIGS = {
     "resnet": (("_bs128_", "_nhwc"), ("_remat", "_bnfuse", "nchw")),
-    "lstm": (("_bs64",), ()),
-    "infer": (("_bs16", "_bnfold"), ()),
-    "gpt": (("_seq1024",), ("_remat",)),
-    "gpt_gen": (("_p64_g192",), ()),
+    "lstm": (("_bs64_",), ()),
+    "infer": (("_bs16_", "_bnfold"), ()),
+    "gpt": (("_seq1024_",), ("_remat",)),
+    "gpt_gen": (("_p64_g192_",), ()),
 }
 
 
@@ -126,7 +126,13 @@ def load_cached_onchip(repo_root):
     import json
 
     best = {}  # kind -> ((is_anchor, captured_utc), result)
-    for d in EVIDENCE_DIR_HISTORY:
+    # the EVIDENCE_DIR override (honored by evidence_dir()/pause_file())
+    # must also steer the scan — an overridden daemon writes there
+    dirs = []
+    for d in (os.environ.get("EVIDENCE_DIR"),) + EVIDENCE_DIR_HISTORY:
+        if d and d not in dirs:
+            dirs.append(d)
+    for d in dirs:
         for path in sorted(glob.glob(os.path.join(repo_root, d, "*.json"))):
             try:
                 with open(path) as f:
