@@ -119,7 +119,15 @@ def _repeats() -> int:
     return max(1, int(os.environ.get("BENCH_REPEATS", "3")))
 
 
-def _timed_loop(exe, feed, fetch, warmup, iters, program=None):
+def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
+                feed_stream=None):
+    """feed_stream: optional list of HOST (numpy) batches — the
+    production-loop measurement (VERDICT r4 Weak #1): each timed
+    iteration stages a DIFFERENT batch via async device_put before
+    dispatching the step, so the number includes host->device transfer
+    with XLA free to overlap it against the previous step's compute.
+    The plain mode (feed pre-staged once) stays the compute-path
+    number."""
     _mark("compile+warmup")
     for _ in range(warmup):
         (out,) = exe.run(program, feed=feed, fetch_list=[fetch])
@@ -132,9 +140,20 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None):
     passes = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            (out,) = exe.run(program, feed=feed, fetch_list=[fetch],
-                             return_numpy=False)
+        if feed_stream:
+            import jax
+
+            dev = exe.place.jax_device()
+            for i in range(iters):
+                staged = {k: jax.device_put(v, dev)
+                          for k, v in feed_stream[i % len(feed_stream)]
+                          .items()}
+                (out,) = exe.run(program, feed=staged, fetch_list=[fetch],
+                                 return_numpy=False)
+        else:
+            for _ in range(iters):
+                (out,) = exe.run(program, feed=feed, fetch_list=[fetch],
+                                 return_numpy=False)
         # completion barrier by VALUE fetch, not block_until_ready: a
         # degraded tunnel session was observed (r4) acknowledging
         # readiness without having executed — a device->host read of the
@@ -202,12 +221,23 @@ def bench_resnet_train(warmup, iters, layout=None):
                              dtype=np_dtype(dtype)),
         "label": jnp.asarray(rng.randint(0, 1000, (bs, 1)).astype(np.int64)),
     })
-    dt = _timed_loop(exe, feed, avg_cost, warmup, iters)
+    # BENCH_FEED=stream: the production-loop number — distinct host
+    # batches staged per step (async device_put overlapping compute)
+    stream = None
+    if os.environ.get("BENCH_FEED") == "stream":
+        stream = [{
+            "image": (rng.rand(*img_shape).astype(np.float32)
+                      .astype(np_dtype(dtype))),
+            "label": rng.randint(0, 1000, (bs, 1)).astype(np.int64),
+        } for _ in range(4)]
+    dt = _timed_loop(exe, feed, avg_cost, warmup, iters,
+                     feed_stream=stream)
     img_s = bs / dt
     out = {
         "metric": f"resnet{depth}_train_img_per_s_{dtype}_bs{bs}_"
                   f"{layout.lower()}{'_remat' if remat else ''}"
-                  f"{'_bnfuse' if fuse_bn and layout == 'NHWC' else ''}",
+                  f"{'_bnfuse' if fuse_bn and layout == 'NHWC' else ''}"
+                  f"{'_stream' if stream else ''}",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s / RESNET_TRAIN_BASE, 2),
@@ -386,12 +416,21 @@ def bench_gpt_train(warmup, iters):
     n_layers = int(os.environ.get("BENCH_NLAYERS", "8"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"  # long-T memory lever
+    # long-T memory levers: BENCH_REMAT=1 checkpoints every block (model-
+    # level), BENCH_REMAT=auto runs the selective desc-level liveness pass
+    # (memory_optimize) which marks grad ops only if the projected peak
+    # exceeds the chip's HBM — the config where remat EARNS its FLOPs
+    remat_env = os.environ.get("BENCH_REMAT", "0")
+    remat = remat_env == "1"
     n_heads = _gpt_heads(dim)
     loss = transformer.build_lm_train_program(
         seq_len=seq_len, vocab_size=32000, dim=dim,
         n_layers=n_layers, n_heads=n_heads, dtype=dtype,
         remat=remat)
+    auto_marks = None
+    if remat_env == "auto":
+        auto_marks = fluid.memory_optimize(
+            fluid.default_main_program(), batch_size=bs)
     place = fluid.default_place()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
@@ -405,12 +444,15 @@ def bench_gpt_train(warmup, iters):
     tok_s = bs * seq_len / dt
     out = {
         "metric": f"gpt_d{dim}_l{n_layers}_h{n_heads}_train_tok_per_s"
-                  f"_{dtype}_bs{bs}_seq{seq_len}{'_remat' if remat else ''}",
+                  f"_{dtype}_bs{bs}_seq{seq_len}{'_remat' if remat else ''}"
+                  f"{'_rematauto' if auto_marks is not None else ''}",
         "value": round(tok_s, 0),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "note": "beyond-reference model family: no anchor row exists",
     }
+    if auto_marks is not None:
+        out["memory_optimize_marks"] = auto_marks
     _attach_mfu(out, exe, loss, feed, dt)
     return out
 

@@ -59,7 +59,16 @@ def _snap_blocks(block_q: int, block_k: int, T: int,
     """Aligned (bq, bk) for the public kernel entry points, failing with a
     clear Python error at trace time instead of a Mosaic one at run time.
     Interpret mode has no Mosaic tile contract (tests run tiny T/blocks
-    there), so it keeps plain largest-divisor snapping."""
+    there), so it keeps plain largest-divisor snapping.
+
+    PADDLE_TPU_FLASH_BQ / PADDLE_TPU_FLASH_BK override the requested
+    blocks process-wide — the block-size sweep knob (read at trace time;
+    sweep runs use a fresh process per point, as make_flash_train's
+    memoization keys on the ARGUMENT blocks, not the env)."""
+    import os
+
+    block_q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", block_q))
+    block_k = int(os.environ.get("PADDLE_TPU_FLASH_BK", block_k))
     tile = 1 if interpret else 128
     bq = _snap_block(block_q, T, tile)
     bk = _snap_block(block_k, T, tile)

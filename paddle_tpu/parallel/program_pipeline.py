@@ -400,4 +400,4 @@ class ProgramPipeline:
             self._packed, vel, feeds_micro, key)
         if self._velocity is not None:
             self._velocity = vel
-        return float(np.asarray(loss))
+        return float(np.asarray(loss).ravel()[0])

@@ -89,7 +89,10 @@ def test_memory_optimize_remat_matches():
                           fetch_list=[loss])[0].item())
             for _ in range(3)]
 
-    n = fluid.memory_optimize(prog)
+    # level=1 = blanket remat (the numerics-parity check wants every grad
+    # op on the checkpoint path); level 0 is budget-driven and correctly
+    # marks NOTHING for a model this small (see the selective tests)
+    n = fluid.memory_optimize(prog, level=1)
     assert n > 0
     fluid.reset_global_scope()
     exe2 = fluid.Executor(fluid.CPUPlace())
@@ -285,3 +288,120 @@ def test_executor_optimized_hlo_text():
     exe.run(feed=feed, fetch_list=[loss])
     txt = exe.optimized_hlo(feed=feed, fetch_list=[loss])
     assert "HloModule" in txt and "ENTRY" in txt
+
+
+def test_memory_optimize_selective_is_budget_driven():
+    """The liveness-based pass (reference memory_optimization_transpiler
+    .py:167's discipline on the TPU remat lever): a program whose
+    projected peak fits the HBM budget is left untouched — blanket remat
+    was measured a 37% on-chip LOSS when the step fits (r4) — and a
+    budget smaller than the projection marks only as many grad ops as
+    the projection needs, largest forward footprint first."""
+    from paddle_tpu.memory_optimization_transpiler import (
+        analyze_liveness, projected_peak_bytes)
+
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+
+    proj = projected_peak_bytes(prog, batch_size=64)
+    assert proj["total_bytes"] > 0
+    assert proj["activation_peak_bytes"] > 0
+    live, peak, peak_i = analyze_liveness(block, batch_size=64)
+    assert peak == proj["activation_peak_bytes"]
+    assert live[peak_i] == peak
+
+    # fits comfortably -> zero marks
+    assert fluid.memory_optimize(prog, hbm_bytes=16 * 1024**3) == 0
+    assert not any(op.attrs.get("__remat__") for op in block.ops)
+
+    # budget below the projection -> selective marking, not blanket
+    total_grads = sum(op.type == "generic_grad" for op in block.ops)
+    budget = proj["total_bytes"] // 2
+    n = fluid.memory_optimize(prog, hbm_bytes=budget, batch_size=64)
+    assert 0 < n <= total_grads
+    marked = [op for op in block.ops if op.attrs.get("__remat__")]
+    assert len(marked) == n
+
+    # the marking is peak-aware (code review r5): under the final marking
+    # either the projection actually fits the budget, or every remaining
+    # candidate saves zero bytes at the peak (marking more would pay remat
+    # FLOPs without moving peak HBM)
+    from paddle_tpu.memory_optimization_transpiler import (
+        _grad_candidates, analyze_liveness as _al)
+
+    _, act_peak2, peak_i2 = analyze_liveness(block, 64, marked)
+    if proj["persistent_bytes"] + act_peak2 > int(budget * 0.9):
+        rest = _grad_candidates(block, 64, peak_i2, marked)
+        assert all(s <= 0 for s, _ in rest), rest
+    # and marking strictly reduced the projected activation peak
+    assert act_peak2 < proj["activation_peak_bytes"]
+
+
+def test_memory_optimize_persistent_deficit_stays_selective():
+    """A deficit remat cannot fix (persistent state alone over budget)
+    must NOT degenerate into blanket marking of zero-saving grad ops
+    (code review r5): only candidates that actually shrink the peak get
+    marked."""
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    # budget of 1 byte: persistent params alone exceed it forever
+    n = fluid.memory_optimize(prog, hbm_bytes=1, batch_size=64)
+    marked = [op for op in block.ops if op.attrs.get("__remat__")]
+    assert len(marked) == n
+    from paddle_tpu.memory_optimization_transpiler import (
+        _grad_candidates, analyze_liveness)
+
+    _, _, peak_i = analyze_liveness(block, 64, marked)
+    rest = _grad_candidates(block, 64, peak_i, marked)
+    # nothing left to mark has positive savings — the loop stopped instead
+    # of blanket-marking
+    assert all(s <= 0 for s, _ in rest), rest
+
+
+def test_memory_optimize_projection_scales_with_batch():
+    """-1 batch dims bind to the given batch size, so the projection (and
+    therefore the marking decision) scales with it."""
+    from paddle_tpu.memory_optimization_transpiler import (
+        projected_peak_bytes)
+
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    small = projected_peak_bytes(prog, batch_size=8)
+    big = projected_peak_bytes(prog, batch_size=512)
+    assert big["activation_peak_bytes"] > small["activation_peak_bytes"] * 8
+    assert big["persistent_bytes"] == small["persistent_bytes"]
+
+
+def test_lifetimes_checkpoint_residuals_stay_live():
+    """A marked grad op re-derives only its OWN forward outputs; another
+    marked op's outputs that it consumes are checkpoint residuals and
+    must keep their full lifetime (code review r5: a union-set skip
+    under-counted the live set when adjacent grad ops were both
+    marked)."""
+    from paddle_tpu.memory_optimization_transpiler import _lifetimes
+
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    block = fluid.default_main_program().global_block()
+    grads = [op for op in block.ops if op.type == "generic_grad"]
+    assert len(grads) >= 2
+
+    for a in grads:
+        _, last_a, _ = _lifetimes(block, 64, [a])
+        for b in grads:
+            if b is a:
+                continue
+            _, last_ab, _ = _lifetimes(block, 64, [a, b])
+            own_b = {n for s in b.attrs.get("__fwd_output_slots__", ())
+                     for n in b.input(s)}
+            for name, lu in last_a.items():
+                if name in own_b:
+                    continue  # b legitimately re-derives these
+                assert last_ab.get(name, -1) >= lu, (
+                    f"marking {b.type} shortened residual {name!r}: "
+                    f"{last_ab.get(name)} < {lu}")

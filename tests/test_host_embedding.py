@@ -37,7 +37,7 @@ def test_ctr_with_host_table_trains():
         c, g = exe.run(feed={"emb": vecs, "y": labels},
                        fetch_list=[cost, "emb@GRAD"])
         table.push_grad(ids, np.asarray(g))
-        c = float(np.asarray(c))
+        c = float(np.asarray(c).ravel()[0])
         if first is None:
             first = c
         last = c
@@ -102,7 +102,7 @@ def test_host_table_composes_with_spmd_mesh():
         g = np.asarray(g)
         assert g.shape == (B, DIM)
         table.push_grad(ids, g)
-        c = float(np.asarray(c))
+        c = float(np.asarray(c).ravel()[0])
         first = c if first is None else first
         last = c
     assert last < first * 0.7, (first, last)

@@ -531,14 +531,14 @@ def test_embedding_mp_sharded_matches_replicated():
     loss = build()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
-    single = [float(np.asarray(exe.run(feed=f, fetch_list=[loss])[0]))
+    single = [float(np.asarray(exe.run(feed=f, fetch_list=[loss])[0]).ravel()[0])
               for f in feeds]
     table_single = fluid.global_scope().find_np("embedding_0.w_0").copy()
 
     fluid.reset_global_scope()
     pe = ParallelExecutor(axes={"dp": 2, "mp": 4})
     pe.run(fluid.default_startup_program())
-    multi = [float(np.asarray(pe.run(feed=f, fetch_list=[loss])[0]))
+    multi = [float(np.asarray(pe.run(feed=f, fetch_list=[loss])[0]).ravel()[0])
              for f in feeds]
     w = fluid.global_scope().find("embedding_0.w_0")
     assert tuple(w.sharding.spec) == ("mp", None), w.sharding.spec

@@ -138,7 +138,7 @@ def test_dataset_convert_trains_through_master_chunks(tmp_path):
             xb = np.stack([b[0] for b in batch])
             yb = np.asarray([[b[1]] for b in batch], dtype="float32")
             (l,) = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
-            losses.append(float(np.asarray(l)))
+            losses.append(float(np.asarray(l).ravel()[0]))
             batch = []
         if seen == 120:
             break  # one epoch: the master re-queues tasks per pass

@@ -269,7 +269,7 @@ def test_fused_training_matches_unfused_small_scale():
         rng = np.random.RandomState(7)
         img = rng.rand(8, 8, 8, 64).astype("float32")
         return [float(np.asarray(
-            exe.run(feed={"image": img}, fetch_list=[loss])[0]))
+            exe.run(feed={"image": img}, fetch_list=[loss])[0]).ravel()[0])
             for _ in range(8)]
 
     a, b = run(False), run(True)
@@ -484,7 +484,7 @@ def test_fusion_reaches_recompute_sub_blocks():
         rng = np.random.RandomState(7)
         img = rng.rand(8, 8, 8, 128).astype("float32")
         return [float(np.asarray(
-            exe.run(feed={"image": img}, fetch_list=[loss])[0]))
+            exe.run(feed={"image": img}, fetch_list=[loss])[0]).ravel()[0])
             for _ in range(6)]
 
     a, b = run(False), run(True)

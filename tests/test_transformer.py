@@ -28,7 +28,7 @@ def test_lm_trains_single_device():
     for _ in range(40):
         (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
                         fetch_list=[loss])
-        ls.append(float(np.asarray(lv)))
+        ls.append(float(np.asarray(lv).ravel()[0]))
     assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
 
 
@@ -47,7 +47,7 @@ def test_lm_trains_dp_sp_sharded():
     for _ in range(15):
         (lv,) = pe.run(feed={"tokens": toks, "targets": tgts},
                        fetch_list=[loss])
-        ls.append(float(np.asarray(lv)))
+        ls.append(float(np.asarray(lv).ravel()[0]))
     assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])
 
 
@@ -71,7 +71,7 @@ def test_lm_sharded_matches_single_step():
         for _ in range(3):
             (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
                             fetch_list=[loss])
-            vals.append(float(np.asarray(lv)))
+            vals.append(float(np.asarray(lv).ravel()[0]))
         return vals
 
     single = one_step(False)
@@ -100,7 +100,7 @@ def test_lm_generate_shapes_and_remat():
     for _ in range(10):
         (l1,) = exe.run(feed={"tokens": toks, "targets": tgts},
                         fetch_list=[loss])
-    assert float(np.asarray(l1)) < float(np.asarray(l0))
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
 
 
 def test_lm_generate_kv_cache_matches_tower():
@@ -339,7 +339,7 @@ def test_lm_trains_on_imikolov_stream():
     for _ in range(60):
         (lv,) = exe.run(feed={"tokens": toks, "targets": tgts},
                         fetch_list=[loss])
-        ls.append(float(np.asarray(lv)))
+        ls.append(float(np.asarray(lv).ravel()[0]))
     # the 0.55 bar was validated on the deterministic synthetic stream;
     # a cache-bearing machine serves real PTB, where 60 steps on this
     # tiny model only warrant "clearly decreasing"

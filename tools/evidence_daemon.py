@@ -183,6 +183,15 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},
      580),
+    ("resnet_stream",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",
+      "BENCH_FEED": "stream"}, 580),
+    ("resnet_lhs_flag",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",
+      "XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"},
+     580),
     ("gpt_4k",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "4096", "BENCH_BS": "2",

@@ -1,24 +1,43 @@
 #!/usr/bin/env bash
-# MFU experiment matrix on the real TPU chip (VERDICT r1 Weak #1): layout
-# A/B, batch-size sweep, and the compiled-flops MFU readout. One command so
-# the whole sweep runs the moment the tunnel is healthy.
+# MFU experiment matrix on the real TPU chip (VERDICT r4 Next #2: attack
+# the 26% ResNet-50 ceiling with the r4 A/B discipline).  One command so
+# the whole sweep runs the moment the tunnel is healthy.  Each point is a
+# fresh process (clean device; compile cache warm after its first run).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== layout A/B at bs128 =="
-for layout in NHWC NCHW; do
-    BENCH_MODEL=resnet BENCH_LAYOUT=$layout python bench.py 2>/dev/null | tail -1
+echo "== batch-size sweep (NHWC, compute-path) =="
+for bs in 128 256 384 512; do
+    BENCH_MODEL=resnet BENCH_LAYOUT=NHWC BENCH_BS=$bs BENCH_ITERS=10 \
+        python bench.py 2>/dev/null | tail -1
 done
 
-echo "== batch-size sweep (NHWC) =="
-for bs in 64 128 192 256; do
-    BENCH_MODEL=resnet BENCH_LAYOUT=NHWC BENCH_BS=$bs python bench.py \
-        2>/dev/null | tail -1
+echo "== production loop (stream feed, distinct batches, H2D overlapped) =="
+for bs in 128 256; do
+    BENCH_MODEL=resnet BENCH_LAYOUT=NHWC BENCH_BS=$bs BENCH_ITERS=10 \
+        BENCH_FEED=stream python bench.py 2>/dev/null | tail -1
+done
+
+echo "== XLA flag sweep at the best batch size (latency-hiding scheduler) =="
+BS=${MFU_BEST_BS:-256}
+for flags in \
+    "" \
+    "--xla_tpu_enable_latency_hiding_scheduler=true" \
+    ; do
+    echo "-- XLA_FLAGS='$flags' --"
+    XLA_FLAGS="$flags" BENCH_MODEL=resnet BENCH_BS=$BS BENCH_ITERS=10 \
+        python bench.py 2>/dev/null | tail -1
+done
+
+echo "== LM flash block sweep at T=2048 (PADDLE_TPU_FLASH_BQ/BK) =="
+for blocks in "512 1024" "256 1024" "512 2048" "1024 1024" "256 512"; do
+    set -- $blocks
+    echo "-- bq=$1 bk=$2 --"
+    PADDLE_TPU_FLASH_BQ=$1 PADDLE_TPU_FLASH_BK=$2 BENCH_MODEL=gpt \
+        BENCH_SEQLEN=2048 BENCH_BS=4 BENCH_ITERS=10 \
+        python bench.py 2>/dev/null | tail -1
 done
 
 echo "== MFU readout (XLA cost_analysis) =="
-for layout in NHWC NCHW; do
-    echo "-- $layout --"
-    python tools/profile_resnet.py --layout $layout 2>/dev/null \
-        | grep -E "step time|throughput|flops|achieved|MFU"
-done
+python tools/profile_resnet.py --layout NHWC 2>/dev/null \
+    | grep -E "step time|throughput|flops|achieved|MFU"
