@@ -54,6 +54,11 @@ class LayerHelper:
             gradient_clip_attr=attr.get("gradient_clip"),
             optimize_attr={"learning_rate": attr.get("learning_rate", 1.0)},
         )
+        # ParameterUpdaterHook parity (reference ParameterUpdaterHook.cpp
+        # via attrs.py HookAttribute): e.g. {"type": "pruning",
+        # "sparsity_ratio": 0.6}; consumed by Optimizer's update pass
+        if attr.get("update_hooks"):
+            param.update_hooks = attr["update_hooks"]
         # startup-program twin + init op
         sblock = self.startup_program.global_block()
         if name not in sblock.vars:

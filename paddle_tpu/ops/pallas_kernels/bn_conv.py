@@ -80,6 +80,21 @@ def _fwd_kernel_res(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps,
               stride=stride)
 
 
+def _prep_activation(x_ref, params_ref, r_ref, eps, act):
+    """Shared prologue for both forward grids: normalize (+residual)
+    (+act), zero-pad to [H+2, W+2, K] in f32 — ONE definition so the v1
+    and v2 bodies cannot drift (code review r5)."""
+    import jax.numpy as jnp
+
+    a = _normalize(x_ref[0], params_ref[...], eps,
+                   None if r_ref is not None else act)
+    if r_ref is not None:
+        a = a + r_ref[0].astype(a.dtype)
+        if act == "relu":
+            a = jnp.maximum(a, 0.0)
+    return jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
+
+
 def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act,
               stride=1):
     import jax
@@ -88,14 +103,8 @@ def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act,
     H, W = x_ref.shape[1], x_ref.shape[2]
     Ho, Wo = H // stride, W // stride
     O = w_ref.shape[-1]
-    a = _normalize(x_ref[0], params_ref[...], eps,
-                   None if r_ref is not None else act)
-    if r_ref is not None:
-        a = a + r_ref[0].astype(a.dtype)
-        if act == "relu":
-            a = jnp.maximum(a, 0.0)
-    a = a.astype(w_ref.dtype)
-    a_pad = jnp.pad(a, ((1, 1), (1, 1), (0, 0)))
+    a_pad = _prep_activation(x_ref, params_ref, r_ref, eps, act).astype(
+        w_ref.dtype)
     acc = jnp.zeros((Ho * Wo, O), jnp.float32)
     for i, tap in enumerate(_taps(a_pad, Ho, Wo, stride)):
         ky, kx = divmod(i, 3)
@@ -103,6 +112,112 @@ def _fwd_body(x_ref, params_ref, w_ref, r_ref, out_ref, *, eps, act,
             tap, w_ref[ky, kx], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
     out_ref[0] = acc.reshape(Ho, Wo, O).astype(out_ref.dtype)
+
+
+def _fwd_body_v2(x_ref, params_ref, w_ref, r_ref, out_ref, apad_sc, *,
+                 eps, act, stride=1):
+    """O-blocked forward: grid (N, O/BO) with the weight walk innermost,
+    so the Pallas pipeline double-buffers each [3,3,K,BO] weight-block
+    DMA against the previous block's nine tap GEMMs — the 'pipelined
+    operand prefetch' the r4 roofline named as the missing piece
+    (perf_resnet50_roofline.md:146-153).  The normalized+padded map is
+    computed once per image at j==0 into VMEM scratch and reused for
+    every weight block, and the per-program VMEM footprint shrinks by
+    O/BO versus the whole-weight v1 grid."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    H, W = x_ref.shape[1], x_ref.shape[2]
+    Ho, Wo = H // stride, W // stride
+    BO = w_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _prep():
+        apad_sc[...] = _prep_activation(
+            x_ref, params_ref, r_ref, eps, act).astype(apad_sc.dtype)
+
+    a_pad = apad_sc[...]
+    acc = jnp.zeros((Ho * Wo, BO), jnp.float32)
+    for i, tap in enumerate(_taps(a_pad, Ho, Wo, stride)):
+        ky, kx = divmod(i, 3)
+        acc += jax.lax.dot_general(
+            tap, w_ref[ky, kx], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    out_ref[0] = acc.reshape(Ho, Wo, BO).astype(out_ref.dtype)
+
+
+def _fwd_kernel_v2(x_ref, params_ref, w_ref, out_ref, apad_sc, **kw):
+    _fwd_body_v2(x_ref, params_ref, w_ref, None, out_ref, apad_sc, **kw)
+
+
+def _fwd_kernel_v2_res(x_ref, params_ref, w_ref, r_ref, out_ref, apad_sc,
+                       **kw):
+    _fwd_body_v2(x_ref, params_ref, w_ref, r_ref, out_ref, apad_sc, **kw)
+
+
+def _v2_block_o(O: int) -> int:
+    """Weight O-block: PADDLE_TPU_BNCONV_BO override, else the largest
+    128-multiple divisor of O at or under 256 (>=2 grid steps when O
+    allows, so the weight-DMA/GEMM overlap actually exists)."""
+    import os
+
+    explicit = int(os.environ.get("PADDLE_TPU_BNCONV_BO", "0"))
+    if explicit and O % explicit == 0:
+        return explicit
+    if O % 128:
+        return O  # un-tileable channel count: whole-weight fallback
+    # 128-multiple blocks only (lane tiling), preferring >=2 grid steps
+    # so the weight-DMA/GEMM overlap exists: 256 when O splits into >=2
+    # such blocks, else 128 (every O%128==0 admits it)
+    if O >= 512 and O % 256 == 0:
+        return 256
+    return 128
+
+
+def bn_conv3x3_fwd_v2(x, gamma, beta, mean, var, w_hwio, r=None,
+                      act="relu", eps=1e-5, stride=1, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, H, W, K = x.shape
+    Ho, Wo = H // stride, W // stride
+    O = w_hwio.shape[-1]
+    BO = _v2_block_o(O)
+    params = jnp.stack([gamma, beta, mean, var]).astype(jnp.float32)
+    in_specs = [
+        pl.BlockSpec((1, H, W, K), lambda n, j: (n, 0, 0, 0)),
+        pl.BlockSpec((4, K), lambda n, j: (0, 0)),
+        pl.BlockSpec((3, 3, K, BO), lambda n, j: (0, 0, 0, j)),
+    ]
+    args = [x, params, w_hwio]
+    if r is not None:
+        in_specs.append(
+            pl.BlockSpec((1, H, W, K), lambda n, j: (n, 0, 0, 0)))
+        args.append(r)
+        kern = functools.partial(_fwd_kernel_v2_res, eps=eps, act=act,
+                                 stride=stride)
+    else:
+        kern = functools.partial(_fwd_kernel_v2, eps=eps, act=act,
+                                 stride=stride)
+    return pl.pallas_call(
+        kern,
+        grid=(N, O // BO),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Ho, Wo, BO),
+                               lambda n, j: (n, 0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, O), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((H + 2, W + 2, K), w_hwio.dtype)],
+        # j must be sequential on a Megacore part: the scratch prep at
+        # j==0 is reused by every later j of the same image
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args)
 
 
 def _bwd_kernel(x_ref, params_ref, w_ref, do_ref, dx_ref, dw_ref, dgb_ref,
@@ -329,17 +444,23 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
                           stride=1, interpret=False):
     """custom_vjp fused bn(+residual)+act+conv3x3 for training
     (generic_grad's jax.vjp honors it).  Takes HWIO weights; memoized
-    per config."""
-    key = (act, eps, has_residual, stride, interpret)
+    per config.  PADDLE_TPU_BNCONV_V2=1 routes the forward through the
+    O-blocked pipelined grid (bn_conv3x3_fwd_v2) — the r5 A/B knob."""
+    import os
+
+    use_v2 = os.environ.get("PADDLE_TPU_BNCONV_V2") == "1"
+    key = (act, eps, has_residual, stride, interpret, use_v2)
     cached = _TRAIN_CACHE.get(key)
     if cached is not None:
         return cached
     import jax
 
+    fwd_impl = bn_conv3x3_fwd_v2 if use_v2 else bn_conv3x3_fwd
+
     if has_residual:
         @jax.custom_vjp
         def f(x, gamma, beta, mean, var, w_hwio, r):
-            return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio, r=r,
+            return fwd_impl(x, gamma, beta, mean, var, w_hwio, r=r,
                                   act=act, eps=eps, stride=stride,
                                   interpret=interpret)
 
@@ -355,7 +476,7 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
     else:
         @jax.custom_vjp
         def f(x, gamma, beta, mean, var, w_hwio):
-            return bn_conv3x3_fwd(x, gamma, beta, mean, var, w_hwio,
+            return fwd_impl(x, gamma, beta, mean, var, w_hwio,
                                   act=act, eps=eps, stride=stride,
                                   interpret=interpret)
 

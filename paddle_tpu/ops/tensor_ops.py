@@ -395,3 +395,26 @@ def arg_sort(ctx, ins, attrs):
         x = x[:, 0]
     return {"Out": [jnp.argsort(x, axis=int(attrs.get("axis", 0))
                                 ).astype(jnp.int64)]}
+
+
+@register_op("pruning_mask", grad=None)
+def pruning_mask(ctx, ins, attrs):
+    """Static pruning mask from parameter magnitudes (reference
+    ParameterUpdaterHook.cpp StaticPruningHook::generateMask — sort
+    |param|, zero the smallest sparsity_ratio fraction).  Runs in the
+    startup program right after the parameter's initializer; the
+    optimizer applies the mask after every update (maskParameter
+    analog), keeping pruned weights at exactly zero through training."""
+    jnp = _j()
+    x = ins["X"][0].astype(jnp.float32)
+    ratio = float(attrs.get("sparsity_ratio", 0.5))
+    absx = jnp.abs(x).ravel()
+    n = absx.shape[0]
+    k = int(max(0.0, min(1.0, ratio)) * n)
+    # count-based like the reference (sort, zero the smallest k by
+    # COUNT): a quantile threshold under-prunes when values tie at the
+    # boundary (e.g. a constant-initialized or already-pruned table
+    # would prune nothing)
+    order = jnp.argsort(absx)
+    mask = jnp.zeros((n,), jnp.float32).at[order[k:]].set(1.0)
+    return {"Out": [mask.reshape(x.shape)]}

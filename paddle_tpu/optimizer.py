@@ -91,8 +91,51 @@ class Optimizer:
         ops = []
         for pg in params_grads:
             ops.append(self._append_optimize_op(block, pg))
+            self._append_update_hooks(block, pg[0])
         self._finish_update(block)
         return ops
+
+    def _append_update_hooks(self, block, param):
+        """ParameterUpdaterHook parity (reference ParameterUpdaterHook.cpp
+        :122 StaticPruningHook): a static pruning mask is computed from
+        the initialized parameter's magnitudes in the STARTUP program
+        (generateMask) and re-applied inside the compiled step after
+        every optimizer update (maskParameter) — pruned weights stay
+        exactly zero through training, all in-graph."""
+        hooks = getattr(param, "update_hooks", None)
+        if not hooks:
+            return
+        if isinstance(hooks, dict):
+            hooks = [hooks]
+        for hk in hooks:
+            kind = hk.get("type") if isinstance(hk, dict) else None
+            if kind != "pruning":
+                raise ValueError(
+                    f"unsupported update hook {hk!r} on {param.name!r}: "
+                    f"only {{'type': 'pruning', 'sparsity_ratio': r}} is "
+                    f"implemented (reference HookAttribute 'pruning')")
+            ratio = float(hk.get("sparsity_ratio", 0.5))
+            mask = self.helper.create_global_variable(
+                name=unique_name.generate(param.name + "_prune_mask"),
+                shape=param.shape, dtype="float32")
+            sblock = default_startup_program().global_block()
+            if mask.name not in sblock.vars:
+                sblock.create_var(name=mask.name, shape=mask.shape,
+                                  dtype="float32", persistable=True)
+            sblock.append_op(
+                "pruning_mask", inputs={"X": [param.name]},
+                outputs={"Out": [mask.name]},
+                attrs={"sparsity_ratio": ratio})
+            # prune the freshly initialized weights too (the reference
+            # masks at init time as part of generateMask)
+            sblock.append_op(
+                "elementwise_mul",
+                inputs={"X": [param.name], "Y": [mask.name]},
+                outputs={"Out": [param.name]}, attrs={})
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [param.name], "Y": [mask.name]},
+                outputs={"Out": [param.name]}, attrs={})
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

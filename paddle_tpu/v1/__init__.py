@@ -15,7 +15,7 @@ objects + settings()-style optimizer config, re-based onto the Program IR.
 """
 
 from .activations import *  # noqa: F401,F403
-from .attrs import ExtraAttr, ExtraLayerAttribute, ParamAttr, \
+from .attrs import ExtraAttr, ExtraLayerAttribute, HookAttribute, ParamAttr, \
     ParameterAttribute  # noqa: F401
 from .evaluators import (auc_evaluator, chunk_evaluator,  # noqa: F401
                          classification_error_evaluator, ctc_error_evaluator,

@@ -4,13 +4,37 @@ ParameterAttribute, ExtraLayerAttribute)."""
 from __future__ import annotations
 
 
+class HookAttribute:
+    """Parameter update hook (attrs.py:59 HookAttribute): e.g.
+    HookAttribute('pruning', 0.6) — a static magnitude-pruning mask
+    generated at init and re-applied after every update."""
+
+    SUPPORTED = ("pruning",)
+
+    def __init__(self, type, sparsity_ratio=None):
+        if type not in self.SUPPORTED:
+            raise ValueError(f"hook type {type!r}: supported "
+                             f"{self.SUPPORTED}")
+        if sparsity_ratio is not None \
+                and not 0.0 <= float(sparsity_ratio) <= 1.0:
+            raise ValueError("sparsity_ratio must be in [0, 1]")
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+
+    def to_hook_dict(self) -> dict:
+        d = {"type": self.type}
+        if self.sparsity_ratio is not None:
+            d["sparsity_ratio"] = float(self.sparsity_ratio)
+        return d
+
+
 class ParameterAttribute:
     """Maps onto the fluid param_attr dict: name, initializer, l2 decay."""
 
     def __init__(self, name=None, initial_std=None, initial_mean=None,
                  initial_max=None, initial_min=None, l1_rate=None,
                  l2_rate=None, learning_rate=1.0, is_static=False,
-                 sparse_update=False):
+                 sparse_update=False, update_hooks=None):
         self.name = name
         self.initial_std = initial_std
         self.initial_mean = initial_mean
@@ -20,6 +44,7 @@ class ParameterAttribute:
         self.learning_rate = learning_rate
         self.is_static = is_static
         self.sparse_update = sparse_update
+        self.update_hooks = update_hooks
 
     def to_param_attr(self) -> dict:
         from ..framework.initializer import (NormalInitializer,
@@ -34,6 +59,13 @@ class ParameterAttribute:
         elif self.initial_max is not None or self.initial_min is not None:
             attr["initializer"] = UniformInitializer(
                 float(self.initial_min or -1.0), float(self.initial_max or 1.0))
+        if self.update_hooks is not None:
+            hooks = self.update_hooks
+            if not isinstance(hooks, (list, tuple)):
+                hooks = [hooks]
+            attr["update_hooks"] = [
+                h.to_hook_dict() if isinstance(h, HookAttribute) else h
+                for h in hooks]
         return attr
 
 

@@ -405,3 +405,76 @@ def test_lifetimes_checkpoint_residuals_stay_live():
                 assert last_ab.get(name, -1) >= lu, (
                     f"marking {b.type} shortened residual {name!r}: "
                     f"{last_ab.get(name)} < {lu}")
+
+
+def test_pruning_update_hook():
+    """ParameterUpdaterHook parity (reference ParameterUpdaterHook.cpp
+    StaticPruningHook + attrs.py HookAttribute): a parameter with a
+    pruning hook gets a static magnitude mask at startup, and the mask
+    is re-applied after every optimizer update — pruned weights are
+    exactly zero at init and STAY zero through training while the rest
+    learn."""
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(
+        input=x, size=32, act="relu",
+        param_attr={"update_hooks": {"type": "pruning",
+                                     "sparsity_ratio": 0.5}})
+    logits = fluid.layers.fc(input=h, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w0 = fluid.global_scope().find_np("fc_0.w_0")
+    zero0 = (w0 == 0.0)
+    # ~half the weights pruned at init (quantile boundary: allow slack)
+    assert 0.4 <= zero0.mean() <= 0.6, zero0.mean()
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(32, 16).astype(np.float32)
+    ys = rng.randint(0, 4, (32, 1)).astype(np.int64)
+    for _ in range(5):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w5 = fluid.global_scope().find_np("fc_0.w_0")
+    # pruned positions stayed exactly zero; surviving weights trained
+    assert (w5[zero0] == 0.0).all()
+    assert (w5[~zero0] != w0[~zero0]).any()
+    # the OTHER fc (no hook) has no mask side effects
+    assert not (fluid.global_scope().find_np("fc_1.w_0") == 0.0).all()
+
+
+def test_pruning_hook_via_v1_attr():
+    """HookAttribute('pruning', r) flows from the v1 ParameterAttribute
+    surface into the fluid update pass (attrs.py:59 parity)."""
+    from paddle_tpu.v1 import HookAttribute, ParamAttr
+
+    attr = ParamAttr(update_hooks=HookAttribute("pruning", 0.6))
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=attr.to_param_attr())
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w = fluid.global_scope().find_np("fc_0.w_0")
+    assert 0.45 <= (w == 0).mean() <= 0.75, (w == 0).mean()
+    with pytest.raises(ValueError):
+        HookAttribute("dpruning")
+
+
+def test_pruning_mask_count_based_under_ties():
+    """The mask is count-based like the reference StaticPruningHook: a
+    constant (all-tied) parameter still gets exactly ratio*N zeros — a
+    quantile threshold would prune nothing (code review r5)."""
+    import jax
+    from paddle_tpu.ops.registry import get_op_info, EmitContext
+    import jax.numpy as jnp
+
+    info = get_op_info("pruning_mask")
+    ctx = EmitContext(jax.random.PRNGKey(0), is_test=True)
+    x = jnp.ones((4, 8), jnp.float32)  # every |x| ties
+    (mask,) = info.emit(ctx, {"X": [x]}, {"sparsity_ratio": 0.75})["Out"]
+    assert float(np.asarray(mask).mean()) == 0.25

@@ -206,6 +206,9 @@ CAPTURES = [
      580),
     ("kernels",
      [sys.executable, "tools/bench_kernels.py"], {}, 600),
+    ("kernels_bnconv_v2",
+     [sys.executable, "tools/bench_kernels.py"],
+     {"PADDLE_TPU_BNCONV_V2": "1"}, 600),
 ]
 
 
