@@ -179,6 +179,10 @@ CAPTURES = [
     ("gpt_gen",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt_gen", "BENCH_ITERS": "4"}, 580),
+    ("gpt_gen_bs1",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "gpt_gen", "BENCH_BS": "1", "BENCH_ITERS": "4"},
+     580),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},
@@ -196,6 +200,10 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "4096", "BENCH_BS": "2",
       "BENCH_ITERS": "10"}, 580),
+    ("gpt_d1024",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "gpt", "BENCH_DIM": "1024", "BENCH_NLAYERS": "12",
+      "BENCH_BS": "4", "BENCH_ITERS": "10"}, 580),
     ("gpt_8k_remat",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt", "BENCH_SEQLEN": "8192", "BENCH_BS": "1",
