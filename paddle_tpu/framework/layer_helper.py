@@ -59,10 +59,16 @@ class LayerHelper:
         # "sparsity_ratio": 0.6}; consumed by Optimizer's update pass
         if attr.get("update_hooks"):
             param.update_hooks = attr["update_hooks"]
-        # startup-program twin + init op
+        # startup-program twin + init op (trainable mirrored: the FSDP
+        # plan collects trainable names across every planned program, and
+        # a twin defaulting to trainable=True would dp-shard a frozen
+        # weight — per-step all-gather traffic for a param that never
+        # changes; code review r5)
         sblock = self.startup_program.global_block()
         if name not in sblock.vars:
-            svar = sblock.create_parameter(name=name, shape=shape, dtype=dtype)
+            svar = sblock.create_parameter(
+                name=name, shape=shape, dtype=dtype,
+                trainable=attr.get("trainable", True))
             init(svar, sblock)
         return param
 

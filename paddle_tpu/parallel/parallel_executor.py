@@ -28,7 +28,7 @@ from .transpiler import DistributeTranspiler, ShardingRules
 class ParallelExecutor(Executor):
     def __init__(self, mesh=None, axes: Optional[Dict[str, int]] = None,
                  rules: Optional[ShardingRules] = None, devices=None,
-                 zero_dp_states: bool = False):
+                 zero_dp_states: bool = False, fsdp_params: bool = False):
         super().__init__(place=None)
         self._pin_device = False
         self.mesh = mesh if mesh is not None else make_mesh(axes, devices)
@@ -39,12 +39,21 @@ class ParallelExecutor(Executor):
         # and updates 1/dp of the optimizer state; GSPMD turns the gradient
         # all-reduce into reduce-scatter + post-update param all-gather
         self.zero_dp_states = bool(zero_dp_states)
+        # ZeRO-3 / FSDP: TRAINABLE parameters themselves shard over 'dp'
+        # on dim 0 (1/dp weight residency per device); GSPMD inserts the
+        # forward/backward all-gathers and grad reduce-scatters — the
+        # sharding-annotation route, no hand-written collectives.  Implies
+        # accumulator sharding (they follow their parameter's sharding).
+        self.fsdp_params = bool(fsdp_params)
+        if fsdp_params:
+            self.zero_dp_states = True
         self._active_scope = None
         # positive identification: ZeRO reshards ONLY variables tagged
         # `accumulator_for` by Optimizer._add_accumulator — never model state
         # like batch-norm running stats, nor a user param whose name happens
         # to extend another param's name with '_'
         self._accum_owner: Dict[str, str] = {}
+        self._trainable_params: set = set()
 
     # ------------------------------------------------------------------
     def _plan_for(self, program):
@@ -57,7 +66,14 @@ class ParallelExecutor(Executor):
                 v.name: v.accumulator_for
                 for v in program.global_block().vars.values()
                 if getattr(v, "accumulator_for", None)})
-            if (self.zero_dp_states and not self._accum_owner
+            self._trainable_params.update(
+                v.name for v in program.global_block().vars.values()
+                if v.persistable and getattr(v, "trainable", False))
+            # an accumulator-free optimizer (plain SGD) under fsdp_params
+            # is working as intended — params are the sharded state — so
+            # the missing-tag warning only applies to explicit ZeRO-1
+            if (self.zero_dp_states and not self.fsdp_params
+                    and not self._accum_owner
                     and any(op.type.endswith("_grad") or
                             op.type == "generic_grad"
                             for op in program.global_block().ops)):
@@ -86,11 +102,15 @@ class ParallelExecutor(Executor):
         return self._replicated()
 
     def _maybe_zero_shard(self, name, sharding):
-        """ZeRO-1: shard an optimizer accumulator (a var positively tagged by
-        the optimizer) over the replica axis on dim 0 when divisible."""
+        """ZeRO-1: shard an optimizer accumulator (a var positively tagged
+        by the optimizer) over the replica axis on dim 0 when divisible.
+        ZeRO-3 (fsdp_params): trainable parameters shard the same way —
+        GSPMD then all-gathers them for compute and reduce-scatters their
+        gradients, giving 1/dp weight residency with identical numerics."""
         if not self.zero_dp_states:
             return sharding
-        if name not in self._accum_owner:
+        if name not in self._accum_owner and not (
+                self.fsdp_params and name in self._trainable_params):
             return sharding
         from jax.sharding import NamedSharding, PartitionSpec
 
