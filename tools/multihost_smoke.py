@@ -58,7 +58,10 @@ def child(pid: int, n: int, coordinator: str):
         fluid.layers.softmax_with_cross_entropy(logits, y))
     fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
 
-    pe = ParallelExecutor(axes={"dp": world})
+    # fsdp_params: each process holds 1/dp of every weight — the ZeRO-3
+    # layout crossing the process boundary (GSPMD all-gathers ride the
+    # inter-host transport), numerics identical to replicated dp
+    pe = ParallelExecutor(axes={"dp": world}, fsdp_params=True)
     pe.run(fluid.default_startup_program())
 
     # every process feeds the IDENTICAL global batch (same seed);
