@@ -195,3 +195,30 @@ def proximal_adagrad(ctx, ins, attrs):
         / (1.0 + lr_t * l2)
     )
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("average_accumulates", grad=None)
+def average_accumulates(ctx, ins, attrs):
+    """Sliding-window parameter-sum accumulation (reference
+    paddle/parameter/AverageOptimizer.cpp — PARAMETER_SUM rotation; same
+    op name as later fluid).  Two-buffer window: the CURRENT window sum
+    accumulates every step; when it reaches max_average_window steps it
+    rotates into the PREVIOUS slot and restarts, so the average always
+    covers the last W..2W updates — the windowed-mean guarantee of the
+    reference's sum1/sum2/sum3 scheme with one fewer buffer."""
+    jnp = _jnp()
+    p = ins["Param"][0]
+    cur_sum, prev_sum = ins["InSum1"][0], ins["InSum2"][0]
+    cnt = ins["InNumAccumulates"][0].reshape(())
+    old = ins["InOldNumAccumulates"][0].reshape(())
+    W = int(attrs.get("max_average_window", 10000))
+    cur = cur_sum + p.astype(cur_sum.dtype)
+    n = cnt + 1
+    shift = n >= W
+    out_prev = jnp.where(shift, cur, prev_sum)
+    out_old = jnp.where(shift, n, old)
+    out_cur = jnp.where(shift, jnp.zeros_like(cur), cur)
+    out_n = jnp.where(shift, jnp.zeros_like(n), n)
+    return {"OutSum1": [out_cur], "OutSum2": [out_prev],
+            "OutNumAccumulates": [out_n.reshape(1)],
+            "OutOldNumAccumulates": [out_old.reshape(1)]}

@@ -367,6 +367,134 @@ class AdadeltaOptimizer(Optimizer):
         )
 
 
+class ModelAverage:
+    """Sliding-window parameter averaging (reference
+    paddle/parameter/AverageOptimizer.cpp + trainer_config_helpers
+    settings(average_window=..., max_average_window=...); same API shape
+    as later fluid's ModelAverage).
+
+    Build AFTER minimize(): appends one in-graph `average_accumulates`
+    op per trainable parameter, so the window sums update inside the
+    SAME compiled train step (no host round-trip).  At eval time::
+
+        ma = fluid.optimizer.ModelAverage(max_average_window=500)
+        ... train steps ...
+        with ma.apply(exe):      # params <- windowed average
+            evaluate / save
+        # params restored on exit (restore() also public)
+
+    average_window_rate / min_average_window are accepted for API
+    parity; the window length is max_average_window updates (the
+    two-buffer rotation guarantees the average covers the last W..2W
+    updates, the reference's windowed-mean behavior).
+    """
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, program=None):
+        from .framework.core import default_main_program
+
+        self.max_average_window = int(max_average_window)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        program = program if program is not None else default_main_program()
+        self.block = program.global_block()
+        # route var creation + init ops through the SAME program (and its
+        # startup twin): a helper bound to the default program would
+        # register the accumulator vars in a different block than the
+        # average_accumulates ops reference (code review r5)
+        self.helper = LayerHelper("model_average", main_program=program)
+        self._params = [v for v in self.block.vars.values()
+                        if v.persistable and getattr(v, "trainable", False)]
+        if not self._params:
+            raise ValueError(
+                "ModelAverage found no trainable parameters: construct it "
+                "AFTER building the model (and after minimize())")
+        self._accs = {}
+        for p in self._params:
+            names = {}
+            for suffix, shape, dtype in (
+                    ("sum_1", p.shape, "float32"),
+                    ("sum_2", p.shape, "float32"),
+                    ("num_acc", (1,), "float32"),
+                    ("old_num_acc", (1,), "float32")):
+                v = self.helper.create_global_variable(
+                    name=unique_name.generate(f"{p.name}_avg_{suffix}"),
+                    shape=shape, dtype=dtype)
+                v.accumulator_for = p.name  # ZeRO/FSDP sharding follows p
+                self.helper.set_initialized(v, ConstantInitializer(0.0))
+                names[suffix] = v.name
+            self.block.append_op(
+                "average_accumulates",
+                inputs={"Param": [p.name], "InSum1": [names["sum_1"]],
+                        "InSum2": [names["sum_2"]],
+                        "InNumAccumulates": [names["num_acc"]],
+                        "InOldNumAccumulates": [names["old_num_acc"]]},
+                outputs={"OutSum1": [names["sum_1"]],
+                         "OutSum2": [names["sum_2"]],
+                         "OutNumAccumulates": [names["num_acc"]],
+                         "OutOldNumAccumulates": [names["old_num_acc"]]},
+                attrs={"max_average_window": self.max_average_window,
+                       "average_window_rate": float(average_window_rate),
+                       "min_average_window": int(min_average_window)})
+            self._accs[p.name] = names
+        self._backup = None
+
+    def _scope(self, scope=None):
+        from .framework.scope import global_scope
+
+        return scope if scope is not None else global_scope()
+
+    def apply(self, executor=None, scope=None, need_restore=True):
+        """Swap every trainable param to its windowed average (host-side
+        gather; a no-op average of zero accumulated steps keeps the raw
+        value).  Returns a context manager restoring on exit when
+        need_restore (the fluid contract)."""
+        import contextlib
+
+        import numpy as np
+
+        scope = self._scope(scope)
+        if self._backup is not None:
+            raise RuntimeError(
+                "ModelAverage.apply() while a previous apply() is still "
+                "active: restore() first (nesting would back up the "
+                "averaged values and lose the raw parameters)")
+        self._backup = {}
+        for p in self._params:
+            names = self._accs[p.name]
+            raw = scope.find_np(p.name)
+            s1 = scope.find_np(names["sum_1"])
+            s2 = scope.find_np(names["sum_2"])
+            n = float(scope.find_np(names["num_acc"]).ravel()[0])
+            o = float(scope.find_np(names["old_num_acc"]).ravel()[0])
+            self._backup[p.name] = raw
+            total = n + o
+            if total > 0:
+                avg = ((s1 + s2) / total).astype(raw.dtype)
+                scope.set(p.name, avg)
+
+        ma = self
+
+        @contextlib.contextmanager
+        def _guard():
+            try:
+                yield ma
+            finally:
+                if need_restore:
+                    ma.restore(scope=scope)
+
+        return _guard()
+
+    def restore(self, executor=None, scope=None):
+        """Put the raw (non-averaged) parameter values back."""
+        scope = self._scope(scope)
+        if not self._backup:
+            return
+        for name, raw in self._backup.items():
+            scope.set(name, raw)
+        self._backup = None
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer

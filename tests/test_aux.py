@@ -478,3 +478,61 @@ def test_pruning_mask_count_based_under_ties():
     x = jnp.ones((4, 8), jnp.float32)  # every |x| ties
     (mask,) = info.emit(ctx, {"X": [x]}, {"sparsity_ratio": 0.75})["Out"]
     assert float(np.asarray(mask).mean()) == 0.25
+
+
+def test_model_average_windowed_mean():
+    """ModelAverage (reference AverageOptimizer / average_window): the
+    in-graph window sums track every update; apply() swaps params to the
+    windowed mean and restores on exit; training continues unaffected."""
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(max_average_window=100)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = _data()
+
+    snaps = []
+    for _ in range(6):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        snaps.append(fluid.global_scope().find_np("fc_0.w_0").copy())
+
+    raw = fluid.global_scope().find_np("fc_0.w_0").copy()
+    with ma.apply(exe):
+        avg = fluid.global_scope().find_np("fc_0.w_0")
+        # window covers all 6 updates: the average IS the mean of the
+        # post-update snapshots
+        np.testing.assert_allclose(avg, np.mean(snaps, axis=0),
+                                   rtol=1e-5, atol=1e-6)
+        assert not np.allclose(avg, raw)
+    # restored on exit
+    np.testing.assert_allclose(
+        fluid.global_scope().find_np("fc_0.w_0"), raw)
+    # training continues fine after restore
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    # nested apply would back up averaged values and lose the raw params:
+    # it must refuse (code review r5)
+    with ma.apply(exe):
+        with pytest.raises(RuntimeError, match="still active"):
+            ma.apply(exe)
+
+
+def test_model_average_window_rotation():
+    """When the step count reaches max_average_window the window rotates
+    (prev <- cur, cur resets): the average then covers the last W..2W
+    updates, never unbounded history."""
+    x, y, logits, loss = _mlp_program()
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(max_average_window=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = _data()
+    snaps = []
+    for _ in range(10):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        snaps.append(fluid.global_scope().find_np("fc_0.w_0").copy())
+    # after 10 steps with W=4: rotations at 4 and 8; cur holds steps
+    # 9-10 (2), prev holds steps 5-8 (4) -> average of the last 6
+    with ma.apply(exe):
+        avg = fluid.global_scope().find_np("fc_0.w_0")
+        np.testing.assert_allclose(avg, np.mean(snaps[4:], axis=0),
+                                   rtol=1e-5, atol=1e-6)
