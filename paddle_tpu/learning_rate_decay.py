@@ -123,3 +123,44 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
         attrs={"scale": float(learning_rate) - float(end_learning_rate),
                "bias": float(end_learning_rate)})
     return lr
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR over the global step — the reference's
+    segment schedulers (paddle/parameter/LearningRateScheduler.cpp:161
+    ManualLRS / :172 PassManualLRS) as in-graph ops:
+    step < boundaries[i] -> values[i], else values[-1]."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError(
+            f"piecewise_decay needs len(values) == len(boundaries)+1, got "
+            f"{len(values)} values for {len(boundaries)} boundaries")
+    helper = LayerHelper("piecewise_decay")
+    step = _global_step(helper)
+    lr = _tmp(helper)
+    helper.append_op("fill_constant", outputs={"Out": [lr.name]},
+                     attrs={"shape": [1], "value": float(values[-1]),
+                            "dtype": "float32"})
+    # walk segments last-to-first: lr = step < b ? v : lr
+    for b, v in reversed(list(zip(boundaries, values))):
+        bound = _tmp(helper)
+        helper.append_op("fill_constant", outputs={"Out": [bound.name]},
+                         attrs={"shape": [1], "value": float(b),
+                                "dtype": "float32"})
+        # bool tmp like the comparison-layer convention — the declared
+        # dtype must match what the op produces
+        cond = helper.create_tmp_variable("bool", shape=(1,),
+                                          stop_gradient=True)
+        helper.append_op("less_than",
+                         inputs={"X": [step.name], "Y": [bound.name]},
+                         outputs={"Out": [cond.name]})
+        seg = _tmp(helper)
+        helper.append_op("fill_constant", outputs={"Out": [seg.name]},
+                         attrs={"shape": [1], "value": float(v),
+                                "dtype": "float32"})
+        nxt = _tmp(helper)
+        helper.append_op("select",
+                         inputs={"Mask": [cond.name], "X": [seg.name],
+                                 "Y": [lr.name]},
+                         outputs={"Out": [nxt.name]})
+        lr = nxt
+    return lr

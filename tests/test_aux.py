@@ -536,3 +536,25 @@ def test_model_average_window_rotation():
         avg = fluid.global_scope().find_np("fc_0.w_0")
         np.testing.assert_allclose(avg, np.mean(snaps[4:], axis=0),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_piecewise_decay_schedule():
+    """piecewise_decay (reference ManualLRS segments): the lr variable
+    steps through its segments as the global step advances."""
+    x, y, logits, loss = _mlp_program()
+    lr = fluid.learning_rate_decay.piecewise_decay(
+        boundaries=[3, 6], values=[0.1, 0.01, 0.001])
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = _data()
+    lrs = []
+    for _ in range(9):
+        out = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss, lr])
+        lrs.append(round(float(out[1].item()), 6))
+    # global step increments before the lr read each run: steps 1..9
+    assert lrs[:2] == [0.1, 0.1], lrs            # step 1-2 < 3
+    assert lrs[2:5] == [0.01, 0.01, 0.01], lrs   # 3 <= step < 6
+    assert lrs[5:] == [0.001] * 4, lrs           # step >= 6
+    with pytest.raises(ValueError):
+        fluid.learning_rate_decay.piecewise_decay([3], [0.1])
