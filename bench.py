@@ -136,9 +136,18 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
     # slowdowns (bs16 inference observed 1382<->3026 img/s back-to-back),
     # and that noise is purely ADDITIVE — the fastest pass is the honest
     # capability number.  BENCH_REPEATS=1 restores single-pass timing.
+    # BENCH_PROFILE=<dir>: capture a jax.profiler trace over the FIRST
+    # timed pass (xplane protos land under <dir>; TensorBoard- and
+    # xprof-readable) — the where-does-the-step-time-go evidence for the
+    # MFU attack
+    profile_dir = os.environ.get("BENCH_PROFILE")
     repeats = _repeats()
     passes = []
-    for _ in range(repeats):
+    for rep in range(repeats):
+        if profile_dir and rep == 0:
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
         t0 = time.perf_counter()
         if feed_stream:
             import jax
@@ -160,6 +169,11 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
         # result is the only wait the transport must honor
         np.asarray(out).ravel()[:1]
         passes.append((time.perf_counter() - t0) / iters)
+        if profile_dir and rep == 0:
+            import jax
+
+            jax.profiler.stop_trace()
+            _mark(f"profile trace written to {profile_dir}")
     _mark("timing done")
     # every per-pass time is recorded in the result JSON (ADVICE r4: the
     # best-of-N headline hides steady-state effects; median/worst must be

@@ -153,3 +153,33 @@ def test_cluster_launch_local(tmp_path):
         capture_output=True, text=True, timeout=120,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 1
+
+
+def test_hlo_parse_module_top_level_excludes_fusion_bodies(tmp_path):
+    """The HBM-traffic roofline needs instructions whose outputs actually
+    materialize: fusion-body internals (register/VMEM values) must not
+    count toward the top-level ledger (r5 — the r4 all-instruction
+    ledger overcounted by ~18x and could not support a bandwidth
+    bound)."""
+    from tools.hlo_analysis import parse_module
+
+    hlo = """HloModule test
+%fused_computation.1 (param_0: f32[128,256]) -> f32[128,256] {
+  %param_0 = f32[128,256]{1,0} parameter(0)
+  %multiply.5 = f32[128,256]{1,0} multiply(%param_0, %param_0)
+  ROOT %add.9 = f32[128,256]{1,0} add(%multiply.5, %param_0)
+}
+ENTRY %main (p: f32[128,256]) -> f32[128,256] {
+  %p = f32[128,256]{1,0} parameter(0)
+  %fusion.1 = f32[128,256]{1,0} fusion(%p), kind=kLoop, calls=%fused_computation.1
+  ROOT %convolution.2 = f32[128,256]{1,0} convolution(%fusion.1, %p), dim_labels=bf_io->bf
+}
+"""
+    p = tmp_path / "m.after_optimizations.txt"
+    p.write_text(hlo)
+    kinds, top, _ = parse_module(str(p))
+    assert kinds["multiply"]["count"] == 1     # visible in the full table
+    assert "multiply" not in top               # but not at top level
+    assert "add" not in top
+    assert top["fusion"]["count"] == 1
+    assert top["convolution"]["count"] == 1

@@ -191,6 +191,10 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",
       "BENCH_FEED": "stream"}, 580),
+    ("resnet_profile",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "resnet", "BENCH_ITERS": "10",
+      "BENCH_PROFILE": "BENCH_attempts_r05/trace_resnet"}, 580),
     ("resnet_lhs_flag",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",
@@ -212,6 +216,9 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "512", "BENCH_ITERS": "5"},
      580),
+    ("hlo_toplevel",
+     [sys.executable, "tools/hlo_analysis.py", "bytes", "--bs", "128",
+      "--tpu"], {}, 900),
     ("kernels",
      [sys.executable, "tools/bench_kernels.py"], {}, 600),
     ("kernels_bnconv_v2",

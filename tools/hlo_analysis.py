@@ -67,12 +67,35 @@ def shape_bytes(spec: str) -> int:
     return total
 
 
+_COMP_HEADER = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->"
+                          r".*\{\s*$")
+
+
 def parse_module(path: str):
-    """Per-kind {count, out_bytes} + per-collective instances."""
+    """Per-kind {count, out_bytes} + per-collective instances.
+
+    Returns (kinds, top_kinds, colls): `kinds` counts EVERY instruction
+    in the module text — including those inside fusion computation
+    bodies, which never touch HBM (their values live in
+    registers/VMEM) — while `top_kinds` counts only instructions outside
+    fusion bodies, i.e. the ops whose outputs actually materialize.
+    Only top_kinds supports an honest HBM-traffic roofline; the all-
+    instruction table remains useful for fusion-content comparisons
+    (r4's fused-vs-unfused ledgers)."""
     kinds = {}
+    top_kinds = {}
     colls = []
+    in_fused = False
     with open(path) as f:
         for line in f:
+            h = _COMP_HEADER.match(line)
+            if h:
+                name = h.group(1)
+                in_fused = "fused" in name or name.startswith("wrapped_")
+                continue
+            if line.strip() == "}":
+                in_fused = False
+                continue
             m = _OPLINE.match(line)
             if not m:
                 continue
@@ -81,10 +104,14 @@ def parse_module(path: str):
             k = kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
             k["count"] += 1
             k["out_bytes"] += b
+            if not in_fused:
+                t = top_kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
+                t["count"] += 1
+                t["out_bytes"] += b
             if kind in COLLECTIVES:
                 colls.append({"op": kind, "out_bytes": b,
                               "shape": spec.strip()[:120]})
-    return kinds, colls
+    return kinds, top_kinds, colls
 
 
 def find_main_module(dump_dir: str, markers) -> str:
@@ -276,15 +303,28 @@ def analyze(mode: str, args) -> dict:
         module = find_main_module(
             dump, COLLECTIVES if mode != "bytes"
             else ("convolution", "custom-call"))
-        kinds, colls = parse_module(module)
+        kinds, top_kinds, colls = parse_module(module)
     total = sum(k["out_bytes"] for k in kinds.values())
+    top_total = sum(k["out_bytes"] for k in top_kinds.values())
+    # HBM write-traffic estimate: top-level compute outputs only —
+    # parameter/tuple/get-tuple-element/bitcast produce no new bytes
+    meta = ("parameter", "tuple", "get-tuple-element", "bitcast",
+            "constant")
+    hbm_writes = sum(v["out_bytes"] for k, v in top_kinds.items()
+                     if k not in meta)
     rec = {
         "analysis": mode if mode == "bytes" else f"collectives:{args.submode}",
         "module": os.path.basename(module),
         "total_out_bytes": total,
+        "top_level_out_bytes": top_total,
+        "hbm_write_bytes_estimate": hbm_writes,
         "by_kind": {k: v for k, v in sorted(
             kinds.items(), key=lambda kv: -kv[1]["out_bytes"])
             if v["out_bytes"] > total * 0.001 or k in COLLECTIVES},
+        "top_level_by_kind": {k: v for k, v in sorted(
+            top_kinds.items(), key=lambda kv: -kv[1]["out_bytes"])
+            if v["out_bytes"] > max(top_total, 1) * 0.001
+            or k in COLLECTIVES},
     }
     if mode == "bytes":
         rec["config"] = {"bs": args.bs, "fuse_bn": args.fuse_bn,
