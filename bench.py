@@ -141,39 +141,44 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
     # xprof-readable) — the where-does-the-step-time-go evidence for the
     # MFU attack
     profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        import jax
     repeats = _repeats()
     passes = []
+    if feed_stream:
+        import jax
     for rep in range(repeats):
-        if profile_dir and rep == 0:
-            import jax
-
+        profiling = profile_dir and rep == 0
+        if profiling:
             jax.profiler.start_trace(profile_dir)
-        t0 = time.perf_counter()
-        if feed_stream:
-            import jax
-
-            dev = exe.place.jax_device()
-            for i in range(iters):
-                staged = {k: jax.device_put(v, dev)
-                          for k, v in feed_stream[i % len(feed_stream)]
-                          .items()}
-                (out,) = exe.run(program, feed=staged, fetch_list=[fetch],
-                                 return_numpy=False)
-        else:
-            for _ in range(iters):
-                (out,) = exe.run(program, feed=feed, fetch_list=[fetch],
-                                 return_numpy=False)
-        # completion barrier by VALUE fetch, not block_until_ready: a
-        # degraded tunnel session was observed (r4) acknowledging
-        # readiness without having executed — a device->host read of the
-        # result is the only wait the transport must honor
-        np.asarray(out).ravel()[:1]
-        passes.append((time.perf_counter() - t0) / iters)
-        if profile_dir and rep == 0:
-            import jax
-
-            jax.profiler.stop_trace()
-            _mark(f"profile trace written to {profile_dir}")
+        try:
+            t0 = time.perf_counter()
+            if feed_stream:
+                dev = exe.place.jax_device()
+                for i in range(iters):
+                    staged = {k: jax.device_put(v, dev)
+                              for k, v in feed_stream[i % len(feed_stream)]
+                              .items()}
+                    (out,) = exe.run(program, feed=staged,
+                                     fetch_list=[fetch],
+                                     return_numpy=False)
+            else:
+                for _ in range(iters):
+                    (out,) = exe.run(program, feed=feed,
+                                     fetch_list=[fetch],
+                                     return_numpy=False)
+            # completion barrier by VALUE fetch, not block_until_ready: a
+            # degraded tunnel session was observed (r4) acknowledging
+            # readiness without having executed — a device->host read of
+            # the result is the only wait the transport must honor
+            np.asarray(out).ravel()[:1]
+            passes.append((time.perf_counter() - t0) / iters)
+        finally:
+            # a pass that dies mid-profile must still flush the partial
+            # trace — it may be the only artifact the capture gets
+            if profiling:
+                jax.profiler.stop_trace()
+                _mark(f"profile trace written to {profile_dir}")
     _mark("timing done")
     # every per-pass time is recorded in the result JSON (ADVICE r4: the
     # best-of-N headline hides steady-state effects; median/worst must be

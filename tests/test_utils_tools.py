@@ -183,3 +183,39 @@ ENTRY %main (p: f32[128,256]) -> f32[128,256] {
     assert "add" not in top
     assert top["fusion"]["count"] == 1
     assert top["convolution"]["count"] == 1
+
+
+def test_hlo_parse_module_while_body_counts_reduce_region_does_not(
+        tmp_path):
+    """Classification is by REFERENCE: a reduce combinator (%region via
+    to_apply=) is inlined, but a while body (body=) materializes its
+    outputs and must count toward the top-level ledger."""
+    from tools.hlo_analysis import parse_module
+
+    hlo = """HloModule t
+%region_0.23 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add.26 = f32[] add(%a, %b)
+}
+%wbody (s: f32[128,256]) -> f32[128,256] {
+  %s = f32[128,256]{1,0} parameter(0)
+  ROOT %multiply.w = f32[128,256]{1,0} multiply(%s, %s)
+}
+%wcond (s2: f32[128,256]) -> pred[] {
+  %s2 = f32[128,256]{1,0} parameter(0)
+  ROOT %constant.c = pred[] constant(false)
+}
+ENTRY %main (p: f32[128,256]) -> f32[] {
+  %p = f32[128,256]{1,0} parameter(0)
+  %while.1 = f32[128,256]{1,0} while(%p), condition=%wcond, body=%wbody
+  %c0 = f32[] constant(0)
+  ROOT %reduce.2 = f32[] reduce(%while.1, %c0), dimensions={0,1}, to_apply=%region_0.23
+}
+"""
+    p = tmp_path / "m.after_optimizations.txt"
+    p.write_text(hlo)
+    _, top, _ = parse_module(str(p))
+    assert "add" not in top
+    assert top["multiply"]["count"] == 1
+    assert top["while"]["count"] == 1

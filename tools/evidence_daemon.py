@@ -193,8 +193,8 @@ CAPTURES = [
       "BENCH_FEED": "stream"}, 580),
     ("resnet_profile",
      [sys.executable, "bench.py"],
-     {"BENCH_MODEL": "resnet", "BENCH_ITERS": "10",
-      "BENCH_PROFILE": "BENCH_attempts_r05/trace_resnet"}, 580),
+     {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",
+      "BENCH_PROFILE": os.path.join(OUT, "trace_resnet")}, 580),
     ("resnet_lhs_flag",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10",

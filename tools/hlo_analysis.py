@@ -69,48 +69,59 @@ def shape_bytes(spec: str) -> int:
 
 _COMP_HEADER = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->"
                           r".*\{\s*$")
+# computations referenced this way are INLINED bodies whose values never
+# materialize in HBM: fusion bodies (calls=), reduce/sort/scatter/select
+# combinators (to_apply=, select=, scatter=).  Control-flow bodies
+# (body=/condition=/branch_computations=) DO materialize their
+# instruction outputs and are deliberately NOT in this set.
+_INLINED_REF = re.compile(
+    r"(?:calls|to_apply|select|scatter)=\{?%?([\w.\-]+)")
 
 
 def parse_module(path: str):
     """Per-kind {count, out_bytes} + per-collective instances.
 
     Returns (kinds, top_kinds, colls): `kinds` counts EVERY instruction
-    in the module text — including those inside fusion computation
-    bodies, which never touch HBM (their values live in
-    registers/VMEM) — while `top_kinds` counts only instructions outside
-    fusion bodies, i.e. the ops whose outputs actually materialize.
+    in the module text — including those inside fusion/combinator
+    bodies, which never touch HBM (their values live in registers/VMEM)
+    — while `top_kinds` counts only instructions in computations that
+    materialize outputs (ENTRY, while/cond bodies).  Classification is
+    by REFERENCE, not name: any computation referenced via
+    calls=/to_apply=/select=/scatter= is an inlined body (code review
+    r5: reduce regions named %region_N would slip a name-based filter).
     Only top_kinds supports an honest HBM-traffic roofline; the all-
     instruction table remains useful for fusion-content comparisons
     (r4's fused-vs-unfused ledgers)."""
+    with open(path) as f:
+        text = f.read()
+    inlined = set(_INLINED_REF.findall(text))
     kinds = {}
     top_kinds = {}
     colls = []
-    in_fused = False
-    with open(path) as f:
-        for line in f:
-            h = _COMP_HEADER.match(line)
-            if h:
-                name = h.group(1)
-                in_fused = "fused" in name or name.startswith("wrapped_")
-                continue
-            if line.strip() == "}":
-                in_fused = False
-                continue
-            m = _OPLINE.match(line)
-            if not m:
-                continue
-            spec, kind = m.groups()
-            b = shape_bytes(spec)
-            k = kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
-            k["count"] += 1
-            k["out_bytes"] += b
-            if not in_fused:
-                t = top_kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
-                t["count"] += 1
-                t["out_bytes"] += b
-            if kind in COLLECTIVES:
-                colls.append({"op": kind, "out_bytes": b,
-                              "shape": spec.strip()[:120]})
+    in_inlined = False
+    for line in text.splitlines():
+        h = _COMP_HEADER.match(line)
+        if h:
+            in_inlined = h.group(1) in inlined
+            continue
+        if line.strip() == "}":
+            in_inlined = False
+            continue
+        m = _OPLINE.match(line)
+        if not m:
+            continue
+        spec, kind = m.groups()
+        b = shape_bytes(spec)
+        k = kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
+        k["count"] += 1
+        k["out_bytes"] += b
+        if not in_inlined:
+            t = top_kinds.setdefault(kind, {"count": 0, "out_bytes": 0})
+            t["count"] += 1
+            t["out_bytes"] += b
+        if kind in COLLECTIVES:
+            colls.append({"op": kind, "out_bytes": b,
+                          "shape": spec.strip()[:120]})
     return kinds, top_kinds, colls
 
 
