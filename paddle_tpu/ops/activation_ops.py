@@ -61,6 +61,8 @@ def _register_all():
         "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
         "gelu": lambda x, a: jax.nn.gelu(x),
         "silu": lambda x, a: jax.nn.silu(x),
+        "sin": lambda x, a: jnp.sin(x),
+        "cos": lambda x, a: jnp.cos(x),
     }
     for name, fn in table.items():
         register_op(name, _make(fn))
@@ -72,7 +74,7 @@ ACTIVATIONS = (
     "sigmoid logsigmoid exp relu tanh tanh_shrink softshrink hard_shrink sqrt "
     "abs ceil floor round reciprocal log square softplus softsign brelu "
     "leaky_relu soft_relu elu relu6 pow stanh thresholded_relu hard_sigmoid "
-    "swish gelu silu"
+    "swish gelu silu sin cos"
 ).split()
 
 

@@ -108,8 +108,10 @@ def test_every_differentiable_op_is_checked_or_excluded():
     # conscious edit of this file, not a silent drift
     # r4: +2 training-fusion ops (bn_act_conv1x1, bn_act_conv3x3), each
     # numerically checked in test_training_fusion.py
-    assert len(diffable) == 146, (
+    # r5: +2 trig ops (sin, cos — the layers/ops.py activation surface),
+    # numerically checked in test_ops_grad_sweep.py
+    assert len(diffable) == 148, (
         f"differentiable-op count changed ({len(diffable)}): update the "
         f"pin AND give each new op a check or an exclusion")
     assert len(EXCLUDED) == 11
-    assert len(checked) == 146 - 11
+    assert len(checked) == 148 - 11

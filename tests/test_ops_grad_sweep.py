@@ -31,6 +31,8 @@ def _away_from(x, points, eps=0.15):
     ("gelu", {}, []),
     ("silu", {}, []),
     ("swish", {"beta": 1.5}, []),
+    ("sin", {}, []),
+    ("cos", {}, []),
     ("leaky_relu", {"alpha": 0.1}, [0.0]),
     ("relu6", {}, [0.0, 6.0]),
     ("softsign", {}, []),

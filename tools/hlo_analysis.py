@@ -94,7 +94,16 @@ def parse_module(path: str):
     (r4's fused-vs-unfused ledgers)."""
     with open(path) as f:
         text = f.read()
-    inlined = set(_INLINED_REF.findall(text))
+    inlined = set()
+    called = set()  # `call` also uses to_apply=, but its computation's
+    # outputs DO materialize (like a while body) — keep those top-level
+    for line in text.splitlines():
+        m = _OPLINE.match(line)
+        if not m:
+            continue
+        refs = _INLINED_REF.findall(line)
+        (called if m.group(2) == "call" else inlined).update(refs)
+    inlined -= called
     kinds = {}
     top_kinds = {}
     colls = []
