@@ -522,6 +522,40 @@ def bench_gpt_generate(warmup, iters):
     }
 
 
+def bench_unet_train(warmup, iters):
+    """DDPM U-Net noise-prediction step throughput — beyond-reference
+    model family (no anchor row exists).  Opt-in via BENCH_MODEL=unet.
+    Overrides: BENCH_BS, BENCH_IMAGE (size), BENCH_UNET_CH (base)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import unet
+
+    bs = int(os.environ.get("BENCH_BS", "64"))
+    size = int(os.environ.get("BENCH_IMAGE", "64"))
+    base = int(os.environ.get("BENCH_UNET_CH", "64"))
+    loss, _ = unet.build_ddpm_train_program(
+        image_size=size, channels=3, base_ch=base, ch_mults=(1, 2, 4))
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    sched = unet.ddpm_schedule(T=1000)
+    rng = np.random.RandomState(0)
+    host = unet.ddpm_feed(
+        rng.rand(bs, 3, size, size).astype(np.float32), sched, rng)
+    feed = _stage(place, {k: jnp.asarray(v) for k, v in host.items()})
+    dt = _timed_loop(exe, feed, loss, warmup, iters)
+    out = {
+        "metric": f"unet_ddpm_{size}px_c{base}_train_img_per_s_bs{bs}",
+        "value": round(bs / dt, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "note": "beyond-reference model family: no anchor row exists",
+    }
+    _attach_mfu(out, exe, loss, feed, dt)
+    return out
+
+
 def bench_lstm_train(warmup, iters):
     """Reference RNN baseline shape (benchmark/README.md:119): stacked
     2xLSTM+fc text classification, bs64 h512 seqlen100 -> 184 ms/batch on
@@ -626,6 +660,9 @@ def main():
         return
     if model == "gpt":
         finish(bench_gpt_train(warmup, iters))
+        return
+    if model == "unet":
+        finish(bench_unet_train(warmup, iters))
         return
     if model == "gpt_gen":
         finish(bench_gpt_generate(warmup, max(1, iters // 4)))

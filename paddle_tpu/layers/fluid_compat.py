@@ -141,7 +141,23 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     w = helper.create_parameter(
         attr=param_attr if isinstance(param_attr, dict) else {},
         shape=[C, int(num_filters)] + ks, dtype=input.dtype)
-    out = helper.create_tmp_variable(input.dtype, shape=None)
+    # static output shape when the spatial dims are known (transposed-
+    # conv arithmetic) — consumers like concat need it (r5 unet).
+    # Unknown dims are -1 in this codebase (conv2d's _od convention):
+    # propagate the sentinel instead of computing garbage from it
+    shape = None
+    if input.shape is not None:
+        st, pd, dl = pair(stride), pair(padding), pair(dilation)
+
+        def _od(i, idx):
+            if i is None or int(i) < 0:
+                return -1
+            return (int(i) - 1) * st[idx] - 2 * pd[idx] \
+                + dl[idx] * (ks[idx] - 1) + 1
+
+        shape = (input.shape[0], int(num_filters),
+                 _od(input.shape[2], 0), _od(input.shape[3], 1))
+    out = helper.create_tmp_variable(input.dtype, shape=shape)
     helper.append_op(
         "conv2d_transpose",
         inputs={"Input": [input.name], "Filter": [w.name]},

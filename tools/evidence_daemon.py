@@ -219,6 +219,9 @@ CAPTURES = [
     ("hlo_toplevel",
      [sys.executable, "tools/hlo_analysis.py", "bytes", "--bs", "128",
       "--tpu"], {}, 900),
+    ("unet",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "unet", "BENCH_ITERS": "10"}, 580),
     ("kernels",
      [sys.executable, "tools/bench_kernels.py"], {}, 600),
     ("kernels_bnconv_v2",
