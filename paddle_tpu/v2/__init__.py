@@ -23,6 +23,9 @@ from . import layer  # noqa: F401
 from . import pooling  # noqa: F401
 from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
+from . import op  # noqa: F401
+from . import minibatch  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
 from . import fluid  # noqa: F401
 from . import master  # noqa: F401
 from . import topology  # noqa: F401

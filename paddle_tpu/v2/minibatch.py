@@ -1,0 +1,7 @@
+"""v2 minibatch module (reference python/paddle/v2/minibatch.py:18):
+`paddle.v2.minibatch.batch` is the same reader transformer exported at
+the package top level (paddle_tpu.reader.batch)."""
+
+from ..reader import batch  # noqa: F401
+
+__all__ = ["batch"]

@@ -54,10 +54,12 @@ class SGD:
                          self.program.global_block().vars.values()
                          if v.is_data and not v.name.endswith("@LENGTH")]
             return DataFeeder(feed_list=data_vars, program=self.program)
-        names = [None] * len(feeding)
-        for name, pos in feeding.items():
-            names[pos] = name
-        return DataFeeder(feed_list=names, program=self.program)
+        # shared with v2.DataFeeder (data_feeder.py): non-contiguous /
+        # subset feeding maps project the sample columns first
+        from .data_feeder import ProjectingFeeder, pairs_from_feeding
+
+        return ProjectingFeeder(pairs_from_feeding(feeding),
+                                program=self.program)
 
     # ------------------------------------------------------------------
     def train(self, reader, num_passes=1,

@@ -167,3 +167,74 @@ def test_v2_image_pipeline(tmp_path):
     rec = pickle.load(open(batch_files[0], "rb"))
     assert rec["label"] == [3]
     assert v2img.load_image_bytes(rec["data"][0]).shape == (48, 64, 3)
+
+
+def test_v2_operator_sugar_and_data_feeder():
+    """v2/op.py parity: +, -, unary minus, scalar *, size-1 scaling, and
+    the generated unary math ops compose through v1 layers and TRAIN;
+    v2.DataFeeder converts minibatches with an explicit feeding map
+    (reference v2/op.py + v2/data_feeder.py)."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.v2.op as v2op
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(4))
+    h = paddle.layer.fc(input=x, size=4)
+    gate = paddle.layer.fc(input=x, size=1)
+    out = v2op.tanh((h + x) * 0.5 - 1.0 + gate * h - (-y))
+    cost = paddle.layer.mse_cost(input=out, label=y)
+    opt = paddle.optimizer.Adam(learning_rate=5e-3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=paddle.parameters
+                                 .create(cost), update_equation=opt)
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(4).astype(np.float32),
+             rng.rand(4).astype(np.float32)) for _ in range(32)]
+    costs = []
+    trainer.train(paddle.batch(lambda: iter(data), batch_size=8),
+                  num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None,
+                  feeding={"x": 0, "y": 1})
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+    feeder = paddle.DataFeeder(
+        [("x", paddle.data_type.dense_vector(4)),
+         ("y", paddle.data_type.dense_vector(4))], {"x": 0, "y": 1})
+    feed = feeder(data[:8])
+    assert set(feed.keys()) == {"x", "y"}
+    assert np.asarray(feed["x"]).shape == (8, 4)
+
+    # composition errors match the reference contract
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        h + "nope"
+    big = paddle.layer.fc(input=x, size=3)
+    with _pytest.raises(TypeError):
+        h + big  # unequal sizes, neither is 1
+    with _pytest.raises(TypeError):
+        h * big  # neither operand size-1
+
+
+def test_v2_data_feeder_subset_and_noncontiguous_positions():
+    """Reference contract: samples may carry EXTRA columns and feeding
+    positions need not be contiguous — the feeder projects only the fed
+    columns (code review r5)."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    paddle.layer.data(name="img", type=paddle.data_type.dense_vector(3))
+    paddle.layer.data(name="lbl", type=paddle.data_type.integer_value(4))
+    feeder = paddle.DataFeeder(
+        [("img", paddle.data_type.dense_vector(3)),
+         ("lbl", paddle.data_type.integer_value(4))],
+        {"img": 0, "lbl": 2})  # position 1 (metadata) is never fed
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(3).astype(np.float32), "meta-%d" % i, i % 4)
+            for i in range(6)]
+    feed = feeder(data)
+    assert np.asarray(feed["img"]).shape == (6, 3)
+    assert np.asarray(feed["lbl"]).reshape(-1).tolist() == [
+        0, 1, 2, 3, 0, 1]
