@@ -87,15 +87,10 @@ def cmd_train(args) -> int:
 
 
 def cmd_dump_config(args) -> int:
-    data = _model_bytes(args.model)
-    from .native import program_desc as npd
+    # one implementation for the CLI and paddle.utils.dump_config
+    from .utils.dump_config import dump_config
 
-    txt = npd.text_dump(data)
-    if txt is None:  # toolchain-free fallback
-        from .framework import proto_io
-
-        txt = proto_io.program_to_text(proto_io.parse_program(data))
-    print(txt)
+    dump_config(args.model)
     return 0
 
 

@@ -1,0 +1,43 @@
+"""paddle.utils.dump_config (reference utils/dump_config.py): text-proto
+dump of a model config — the ONE implementation behind both this module
+and `paddle dump_config` (cli.py delegates here)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def dump_config(path, out=None):
+    """Text dump of a saved model: a dir with __model__ (native text_dump,
+    pure-python proto fallback), a raw proto file, or a dir saved without
+    the protoc toolchain (program.json — the io.py JSON fallback).
+
+    out: None prints to stdout; a path writes the file.  The text is
+    always returned."""
+    model = os.path.join(path, "__model__") if os.path.isdir(path) else path
+    if os.path.exists(model):
+        with open(model, "rb") as f:
+            data = f.read()
+        from ..native import program_desc as npd
+
+        txt = npd.text_dump(data)
+        if txt is None:  # native toolchain unavailable on this host
+            from ..framework import proto_io
+
+            txt = proto_io.program_to_text(proto_io.parse_program(data))
+    elif os.path.isdir(path) and os.path.exists(
+            os.path.join(path, "program.json")):
+        # saved without the protoc toolchain: io.py wrote JSON only
+        with open(os.path.join(path, "program.json")) as f:
+            txt = json.dumps(json.load(f), indent=1)
+    else:
+        raise FileNotFoundError(
+            f"no __model__ or program.json under {path!r}")
+    if out is None:
+        sys.stdout.write(txt)
+    else:
+        with open(out, "w") as f:
+            f.write(txt)
+    return txt

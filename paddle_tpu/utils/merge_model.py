@@ -1,0 +1,19 @@
+"""paddle.utils.merge_model (reference utils/merge_model.py): bundle a
+saved inference model (desc + parameters) into one deployable file —
+the same operation `paddle merge_model` runs from the CLI."""
+
+from __future__ import annotations
+
+from .. import io
+
+
+def merge_v2_model(net_file_or_dir, param_file=None, output_file=None):
+    """Reference signature merge_v2_model(net, param_file, output_file);
+    here the saved-inference-model DIRECTORY carries both pieces, so the
+    first argument alone suffices.  param_file is an INPUT in the
+    reference API and is never written to (code review r5: using it as
+    the output fallback would destroy the caller's parameter file)."""
+    return io.merge_model(net_file_or_dir, output_file or "model.merged")
+
+
+merge_model = merge_v2_model

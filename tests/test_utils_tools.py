@@ -219,3 +219,40 @@ ENTRY %main (p: f32[128,256]) -> f32[] {
     assert "add" not in top
     assert top["multiply"]["count"] == 1
     assert top["while"]["count"] == 1
+
+
+def test_utils_module_tools_roundtrip(tmp_path):
+    """paddle.utils.{merge_model,dump_config,make_model_diagram} module
+    forms (reference python/paddle/utils/*.py) share the CLI/net_drawer
+    implementations: save an inference model, merge it, dump its config
+    text, and render the diagram."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.utils import dump_config, make_model_diagram, \
+        merge_model
+
+    fluid.reset()
+    x = fluid.layers.data("ux", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, size=2, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["ux"], [y], exe)
+
+    merged = merge_model.merge_v2_model(d, output_file=str(
+        tmp_path / "bundle.merged"))
+    import os
+
+    assert os.path.getsize(merged) > 0
+
+    cfg_path = str(tmp_path / "config.txt")
+    txt = dump_config.dump_config(d, out=cfg_path)
+    assert "fc" in txt or "mul" in txt
+    assert os.path.getsize(cfg_path) > 0
+
+    dot = make_model_diagram.make_diagram(
+        fluid.default_main_program(),
+        out_file=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph")
+    assert os.path.getsize(tmp_path / "g.dot") > 0
