@@ -37,6 +37,17 @@ class V1Trainer:
         # parameters
         self.test_program = fluid.default_main_program().clone(for_test=True)
         optimizer_from_settings().minimize(self.cost_var)
+        # settings(average_window=...) parity (reference AverageOptimizer:
+        # train accumulates window sums in-graph; test() evaluates on the
+        # averaged parameters)
+        self.model_average = None
+        if conf.get("average_window"):
+            from ..optimizer import ModelAverage
+
+            self.model_average = ModelAverage(
+                average_window_rate=float(conf["average_window"]),
+                max_average_window=int(conf.get("max_average_window")
+                                       or 10000))
         self.place = place if place is not None else fluid.CPUPlace()
         self.exe = fluid.Executor(self.place)
         self.exe.run(fluid.default_startup_program())
@@ -95,15 +106,25 @@ class V1Trainer:
     def test(self):
         """Mean cost over the registered test source: one pass of the
         eval-mode program (cloned before minimize — no parameter updates,
-        BN/dropout in inference mode)."""
+        BN/dropout in inference mode).  Under settings(average_window=),
+        evaluation runs on the window-AVERAGED parameters and restores
+        the raw ones afterward (reference AverageOptimizer apply/restore
+        traversal)."""
+        import contextlib
+
         prov, files = get_data_source("test")
         if prov is None:
             raise RuntimeError("no test data source registered")
-        losses = [
-            float(np.asarray(
-                self.exe.run(self.test_program, feed=feed,
-                             fetch_list=[self.cost_var])[0]).reshape(-1)[0])
-            for feed in prov.batches(files, self.batch_size, seed=0,
-                                     data_layer_names=self.feed_order)
-        ]
+        ctx = (self.model_average.apply(self.exe)
+               if self.model_average is not None
+               else contextlib.nullcontext())
+        with ctx:
+            losses = [
+                float(np.asarray(
+                    self.exe.run(self.test_program, feed=feed,
+                                 fetch_list=[self.cost_var])[0]
+                ).reshape(-1)[0])
+                for feed in prov.batches(files, self.batch_size, seed=0,
+                                         data_layer_names=self.feed_order)
+            ]
         return float(np.mean(losses)) if losses else float("nan")

@@ -266,3 +266,49 @@ def test_streaming_pool_shuffle_bounded():
     # shuffled: not in arrival order
     flat = [int(b["x"][j, 0]) for b in batches for j in range(4)]
     assert flat != list(range(64))
+
+
+def test_v1_settings_average_window_applies_at_test(tmp_path):
+    """settings(average_window=...) parity (reference AverageOptimizer):
+    the trainer accumulates window sums in-graph during train() and
+    test() evaluates on AVERAGED parameters, restoring raw ones after."""
+    import paddle_tpu as fluid
+
+    train_list, dim = _write_cls_files(tmp_path)
+    mod = types.ModuleType("avg_provider")
+
+    @provider(input_types={"features": dense_vector(dim),
+                           "label": integer_value(2)})
+    def process(settings, file_name):
+        for line in open(file_name):
+            feats, lab = line.rsplit(";", 1)
+            yield {"features": [float(t) for t in feats.split()],
+                   "label": int(lab)}
+
+    mod.process = process
+    sys.modules["avg_provider"] = mod
+    try:
+        v1.define_py_data_sources2(train_list, train_list,
+                                   module="avg_provider", obj="process")
+        feats = v1.data_layer(name="features", size=dim)
+        label = v1.data_layer(name="label", size=2, dtype="int64")
+        pred = v1.fc_layer(input=feats, size=2,
+                           act=v1.SoftmaxActivation())
+        cost = v1.classification_cost(input=pred, label=label)
+        v1.settings(batch_size=16, learning_rate=0.1,
+                    average_window=0.5, max_average_window=100)
+        trainer = v1.V1Trainer(cost, batch_size=16)
+        assert trainer.model_average is not None
+        trainer.train(num_passes=4)
+        raw = fluid.global_scope().find_np("fc_0.w_0").copy()
+        test_loss = trainer.test()
+        assert np.isfinite(test_loss)
+        # raw (non-averaged) parameters restored after test()
+        np.testing.assert_allclose(
+            fluid.global_scope().find_np("fc_0.w_0"), raw)
+        # averaged parameters differ from the raw end-of-training ones
+        with trainer.model_average.apply(trainer.exe):
+            avg = fluid.global_scope().find_np("fc_0.w_0")
+            assert not np.allclose(avg, raw)
+    finally:
+        sys.modules.pop("avg_provider", None)
