@@ -534,7 +534,7 @@ def bench_unet_train(warmup, iters):
     bs = int(os.environ.get("BENCH_BS", "64"))
     size = int(os.environ.get("BENCH_IMAGE", "64"))
     base = int(os.environ.get("BENCH_UNET_CH", "64"))
-    loss, _ = unet.build_ddpm_train_program(
+    loss, _, _ = unet.build_ddpm_train_program(
         image_size=size, channels=3, base_ch=base, ch_mults=(1, 2, 4))
     place = fluid.default_place()
     exe = fluid.Executor(place)

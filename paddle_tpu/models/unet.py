@@ -21,8 +21,8 @@ TPU-first choices:
   one compile.
 
 API:
-    loss, eps_hat = build_ddpm_train_program(image_size=32, channels=3)
-    infer_prog = fluid.default_main_program().clone(for_test=True)
+    loss, eps_hat, infer_prog = build_ddpm_train_program(
+        image_size=32, channels=3)   # infer_prog cloned pre-minimize
     # feed (ddpm_feed builds it): image/noise [B,C,H,W],
     #   t / sqrt_ab / sqrt_1mab [B,1] f32
     sched = ddpm_schedule(T=1000)          # host-side linear betas
@@ -116,7 +116,8 @@ def build_ddpm_train_program(image_size=32, channels=3, base_ch=32,
                              optimizer="adam"):
     """Noise-prediction training step: x_t = sqrt_ab*x0 + sqrt_1mab*eps
     built IN-GRAPH from fed coefficients; loss = mean((eps_hat-eps)^2).
-    Returns the loss Variable."""
+    Returns (loss, eps_hat, infer_prog) — infer_prog is the pre-minimize
+    test-mode clone the samplers run."""
     from .. import optimizer as opt
 
     x0 = layers.data("image", shape=[channels, image_size, image_size],
@@ -134,13 +135,19 @@ def build_ddpm_train_program(image_size=32, channels=3, base_ch=32,
                      out_channels=channels)
     loss = layers.mean(layers.square(
         layers.elementwise_sub(eps_hat, eps)))
+    # test-mode clone BEFORE optimizer ops exist: sampling through a
+    # post-minimize clone would keep updating parameters on every
+    # denoise step (the standard fluid clone-before-minimize contract)
+    from ..framework.core import default_main_program
+
+    infer_prog = default_main_program().clone(for_test=True)
     if optimizer == "adam":
         opt.Adam(learning_rate=learning_rate).minimize(loss)
     elif optimizer == "sgd":
         opt.SGD(learning_rate=learning_rate).minimize(loss)
     elif optimizer is not None:
         raise ValueError(f"optimizer {optimizer!r}: use 'adam'/'sgd'/None")
-    return loss, eps_hat
+    return loss, eps_hat, infer_prog
 
 
 def ddpm_schedule(T=1000, beta_start=1e-4, beta_end=0.02):
@@ -171,6 +178,37 @@ def ddpm_feed(x0, sched, rng):
         "sqrt_ab": sched["sqrt_ab"][t].reshape(B, 1),
         "sqrt_1mab": sched["sqrt_1mab"][t].reshape(B, 1),
     }
+
+
+def ddim_sample(exe, infer_prog, eps_hat_var, sched, shape, rng,
+                steps=50):
+    """DDIM (eta=0, deterministic) sampling: the few-step sampler —
+    x_{t-1} = sqrt(ab_prev) * x0_hat + sqrt(1-ab_prev) * eps_hat with
+    x0_hat = (x_t - sqrt(1-ab_t) eps_hat) / sqrt(ab_t).  Same compiled
+    denoise step as ddpm_sample (clone(for_test) identity-feed trick)."""
+    T = sched["T"]
+    use_t = np.linspace(T - 1, 0, steps).round().astype(int)
+    x = rng.randn(*shape).astype(np.float32)
+    B = shape[0]
+    zero = np.zeros(shape, np.float32)
+    one = np.ones((B, 1), np.float32)
+    for k, ti in enumerate(use_t):
+        feed = {
+            "image": x, "noise": zero, "sqrt_ab": one,
+            "sqrt_1mab": np.zeros((B, 1), np.float32),
+            "t": np.full((B, 1), float(ti), np.float32),
+        }
+        (eh,) = exe.run(infer_prog, feed=feed, fetch_list=[eps_hat_var])
+        eh = np.asarray(eh)
+        ab_t = sched["alphas_bar"][ti]
+        x0_hat = (x - np.sqrt(1.0 - ab_t) * eh) / np.sqrt(ab_t)
+        if k == len(use_t) - 1:
+            x = x0_hat
+        else:
+            ab_prev = sched["alphas_bar"][use_t[k + 1]]
+            x = (np.sqrt(ab_prev) * x0_hat
+                 + np.sqrt(1.0 - ab_prev) * eh).astype(np.float32)
+    return x
 
 
 def ddpm_sample(exe, infer_prog, eps_hat_var, sched, shape, rng,

@@ -16,10 +16,9 @@ def _toy_batch(n=16, size=8):
 
 
 def test_ddpm_trains_and_samples():
-    loss, eps_hat = unet.build_ddpm_train_program(
+    loss, eps_hat, infer_prog = unet.build_ddpm_train_program(
         image_size=8, channels=1, base_ch=8, ch_mults=(1, 2),
         learning_rate=2e-3)
-    infer_prog = fluid.default_main_program().clone(for_test=True)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     sched = unet.ddpm_schedule(T=50)
@@ -78,7 +77,7 @@ def test_ddpm_trains_dp_sharded():
     CPU mesh, same program, finite decreasing loss."""
     from paddle_tpu.parallel import ParallelExecutor
 
-    loss, _ = unet.build_ddpm_train_program(
+    loss, _, _ = unet.build_ddpm_train_program(
         image_size=8, channels=1, base_ch=8, ch_mults=(1, 2),
         learning_rate=2e-3)
     pe = ParallelExecutor(axes={"dp": 8})
@@ -93,3 +92,25 @@ def test_ddpm_trains_dp_sharded():
         ls.append(float(np.asarray(l).ravel()[0]))
     assert np.isfinite(ls).all()
     assert ls[-1] < ls[0], (ls[0], ls[-1])
+
+
+def test_ddim_sampler_deterministic_and_finite():
+    """DDIM (eta=0): deterministic given the same starting noise — two
+    runs from the same rng state agree exactly — and finite at few
+    steps."""
+    loss, eps_hat, infer_prog = unet.build_ddpm_train_program(
+        image_size=8, channels=1, base_ch=8, ch_mults=(1, 2),
+        learning_rate=2e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sched = unet.ddpm_schedule(T=50)
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        exe.run(feed=unet.ddpm_feed(_toy_batch(8), sched, rng),
+                fetch_list=[loss])
+    a = unet.ddim_sample(exe, infer_prog, eps_hat, sched, (2, 1, 8, 8),
+                         np.random.RandomState(7), steps=8)
+    b = unet.ddim_sample(exe, infer_prog, eps_hat, sched, (2, 1, 8, 8),
+                         np.random.RandomState(7), steps=8)
+    assert np.isfinite(a).all()
+    np.testing.assert_allclose(a, b)
