@@ -18,13 +18,17 @@ Whole train step (fwd+bwd+momentum update) is one compiled XLA program; conv
 stack runs in bfloat16 on the MXU, loss head + BN stats in float32.
 BENCH_MODEL=resnet|lstm|infer|all selects modes (default all); the extra
 opt-in single-model modes alexnet|googlenet|vgg (VGG-19) anchor the other
-BASELINE.md CNN rows and are not part of "all".
+BASELINE.md CNN rows, gpt/gpt_gen the transformer-LM rows, and unet the
+diffusion family — none are part of "all".
 Overrides: BENCH_BS (resnet-train; also lstm when BENCH_MODEL=lstm),
 BENCH_LSTM_BS, BENCH_INFER_BS, BENCH_DTYPE, BENCH_ITERS, BENCH_LAYOUT
 (NHWC default / NCHW), BENCH_REPEATS (timing passes per mode, default 3;
 the reported number is the BEST pass — tunnel noise is additive — and
 each result carries a "timing" field recording the methodology;
-BENCH_REPEATS=1 restores single-pass timing).
+BENCH_REPEATS=1 restores single-pass timing).  BENCH_FEED=stream times
+the production loop (distinct host batches staged per step);
+BENCH_PROFILE=<dir> captures a jax.profiler trace over the first timed
+pass; BENCH_REMAT=auto runs the selective liveness pass (gpt mode).
 
 Evidence-first engineering (VERDICT r2 Weak #1): the combined run STREAMS —
 after every mode completes, a full cumulative headline JSON line is printed
