@@ -61,6 +61,11 @@ def cmd_train(args) -> int:
     # --config: the reference trainer flow (submit_local.sh `paddle train
     # --config=conf.py [--job=time]`): exec a v1 config that declares data
     # sources, topology ending in outputs(cost), and settings(); then train
+    # unconditional: an empty value must CLEAR a previous run's args
+    # (module-global state; code review r5)
+    from .trainer.config_parser import set_config_args
+
+    set_config_args(args.config_args or "")
     runpy.run_path(args.config, run_name="__config__")
     from .v1 import V1Trainer
     from .v1.layers import declared_outputs
@@ -80,7 +85,20 @@ def cmd_train(args) -> int:
                           "last_loss": last_loss
                           if math.isfinite(last_loss) else None}))
         return 0
-    losses = trainer.train(num_passes=args.num_passes)
+    save_dir = args.save_dir
+    if save_dir:
+        # reference --save_dir layout: persistables under pass-%05d/
+        from . import io as fluid_io
+
+        losses = []
+        for p in range(args.num_passes):
+            losses += trainer.train(num_passes=1, start_pass=p)
+            d = os.path.join(save_dir, f"pass-{p:05d}")
+            os.makedirs(d, exist_ok=True)
+            fluid_io.save_persistables(trainer.exe, d)
+            print(f"saved pass {p} -> {d}")
+    else:
+        losses = trainer.train(num_passes=args.num_passes)
     for i, l in enumerate(losses):
         print(f"Pass {i}: cost={l:.6f}")
     return 0
@@ -177,6 +195,12 @@ def main(argv=None) -> int:
     p.add_argument("--num-passes", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=0)
     p.add_argument("--time-batches", type=int, default=5)
+    p.add_argument("--config_args", "--config-args", default="",
+                   help="a=1,b=x values config scripts read via "
+                        "get_config_arg (reference --config_args)")
+    p.add_argument("--save-dir", "--save_dir", default=None,
+                   help="save persistables per pass under "
+                        "SAVE_DIR/pass-%%05d (reference --save_dir)")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_train)
 

@@ -15,6 +15,8 @@ objects + settings()-style optimizer config, re-based onto the Program IR.
 """
 
 from .activations import *  # noqa: F401,F403
+from ..trainer.config_parser import (  # noqa: F401
+    get_config_arg, set_config_args)
 from .attrs import ExtraAttr, ExtraLayerAttribute, HookAttribute, ParamAttr, \
     ParameterAttribute  # noqa: F401
 from .evaluators import (auc_evaluator, chunk_evaluator,  # noqa: F401

@@ -54,17 +54,22 @@ class V1Trainer:
         self._fluid = fluid
 
     def train(self, num_passes: int = 1,
-              event_handler: Optional[Callable] = None):
+              event_handler: Optional[Callable] = None,
+              start_pass: int = 0):
         """Run `num_passes` over the registered train source; returns the
         per-pass mean losses.  event_handler(pass_id, batch_id, loss) is
-        called per batch (v2-style observability on the v1 loop)."""
+        called per batch (v2-style observability on the v1 loop).
+        start_pass offsets the pass ids (and therefore the provider
+        shuffle seeds) — a caller driving one pass at a time (the CLI's
+        --save-dir loop) must keep per-pass shuffling identical to a
+        single num_passes=N call (code review r5)."""
         prov, files = get_data_source("train")
         if prov is None:
             raise RuntimeError(
                 "no train data source — call define_py_data_sources2 in "
                 "the config first")
         pass_losses = []
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, start_pass + num_passes):
             losses = []
             for batch_id, feed in enumerate(
                     prov.batches(files, self.batch_size, seed=pass_id,

@@ -125,3 +125,70 @@ def test_cli_show_pb(tmp_path, capsys):
     assert cli.main(["show_pb", d]) == 0
     out = capsys.readouterr().out
     assert "op mul" in out and "var x" in out
+
+
+def test_cli_train_config_args_and_save_dir(tmp_path, capsys):
+    """--config_args values reach the config via get_config_arg with the
+    reference coercion rules, and --save-dir writes per-pass persistables
+    under pass-%05d (reference --save_dir layout)."""
+    import textwrap
+
+    from paddle_tpu.v1.data_provider import reset_data_sources
+
+    rng = np.random.RandomState(0)
+    data = tmp_path / "d.txt"
+    with open(data, "w") as f:
+        for _ in range(32):
+            lab = rng.randint(0, 2)
+            x = rng.rand(4) * 0.3 + lab * 0.5
+            f.write(" ".join(f"{v:.4f}" for v in x) + f" {lab}\n")
+    prov = tmp_path / "ca_provider.py"
+    prov.write_text(textwrap.dedent("""
+        from paddle_tpu.v1.data_provider import (provider, dense_vector,
+                                                 integer_value)
+
+        @provider(input_types={"x": dense_vector(4),
+                               "label": integer_value(2)})
+        def process(settings, file_name):
+            for line in open(file_name):
+                parts = line.split()
+                yield {"x": [float(v) for v in parts[:4]],
+                       "label": int(parts[4])}
+    """))
+    conf = tmp_path / "ca_conf.py"
+    conf.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {str(tmp_path)!r})
+        from paddle_tpu import v1
+
+        hidden = v1.get_config_arg("hidden", int, 8)
+        use_bn = v1.get_config_arg("use_bn", bool, False)
+        assert hidden == 12, hidden      # from --config_args
+        assert use_bn is True, use_bn
+        v1.define_py_data_sources2({str(data)!r}, None,
+                                   module="ca_provider", obj="process")
+        x = v1.data_layer(name="x", size=4)
+        label = v1.data_layer(name="label", size=2, dtype="int64")
+        h = v1.fc_layer(input=x, size=hidden, act=v1.TanhActivation())
+        pred = v1.fc_layer(input=h, size=2, act=v1.SoftmaxActivation())
+        cost = v1.classification_cost(input=pred, label=label)
+        v1.settings(batch_size=16, learning_rate=0.3)
+        v1.outputs(cost)
+    """))
+    save_dir = tmp_path / "ckpts"
+    try:
+        assert cli.main(["train", "--config", str(conf),
+                         "--config_args", "hidden=12,use_bn=true",
+                         "--num-passes", "2",
+                         "--save-dir", str(save_dir)]) == 0
+        out = capsys.readouterr().out
+        assert "Pass 1" in out
+        for p in range(2):
+            d = save_dir / f"pass-{p:05d}"
+            assert d.is_dir() and any(d.iterdir()), d
+    finally:
+        fluid.reset()
+        reset_data_sources()
+        from paddle_tpu.trainer.config_parser import set_config_args
+
+        set_config_args({})
