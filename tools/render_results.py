@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Render the evidence dir's capture artifacts into a markdown table.
+
+Usage: python tools/render_results.py [evidence_dir]
+Prints a RESULTS.md-ready table of every successful capture row (metric,
+value, unit, vs_baseline, mfu, artifact file) ordered newest-last, plus
+a short list of failed/interrupted captures.  Exists so a tunnel window
+that lands captures unattended (possibly during the driver's own run)
+can be turned into the results table with one command next session.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.probe_common import EVIDENCE_DIR_DEFAULT  # noqa: E402
+
+
+def rows_from(path):
+    try:
+        with open(path) as f:
+            body = json.load(f)
+    except ValueError:
+        with open(path) as f:
+            from tools.probe_common import json_lines
+
+            return json_lines(f.read()), None, ""
+    if not isinstance(body, dict):
+        return [], None, ""
+    res = body.get("results")
+    if res is None and "metric" in body:
+        res = [body]
+    return (res or []), body.get("error"), body.get("captured_utc", "")
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else EVIDENCE_DIR_DEFAULT
+    ok_rows = []
+    failed = []
+    for path in sorted(glob.glob(os.path.join(d, "*.json"))):
+        name = os.path.basename(path)
+        if name == "probe_log.jsonl":
+            continue
+        rows, err, utc = rows_from(path)
+        if err or not rows:
+            failed.append((name, err or "no parsable result rows"))
+            continue
+        # last cumulative line carries everything for bench-suite files
+        last = rows[-1]
+        flat = [last] + [x for x in last.get("extra_metrics", [])
+                         if isinstance(x, dict)]
+        for r in flat:
+            if r.get("unit") == "error" or not r.get("metric"):
+                continue
+            ok_rows.append((utc, name, r))
+
+    print("| capture | metric | value | unit | vs baseline | mfu |")
+    print("|---|---|---|---|---|---|")
+    for utc, name, r in ok_rows:
+        print(f"| {name} | {r['metric']} | {r.get('value')} "
+              f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
+              f"| {r.get('mfu', '')} |")
+    if failed:
+        print("\nFailed/empty captures:")
+        for name, err in failed:
+            print(f"- {name}: {str(err)[:120]}")
+
+
+if __name__ == "__main__":
+    main()
