@@ -1,0 +1,34 @@
+"""Static analysis over Program IR: dataflow, verification, contracts.
+
+The transpilers in this repo (`memory_optimization_transpiler`,
+`inference_transpiler`, `distributed/distribute_transpiler`,
+`parallel/transpiler`) all mutate `Program` descs; this package is the
+well-formedness layer between them — the role TVM's pass-infra validation
+and TensorFlow's pre-execution graph checks play (PAPERS.md).
+
+    from paddle_tpu.analysis import verify_program
+    report = verify_program(program, fetch_names=["mean_0.tmp_0"])
+    report.raise_if_errors()
+
+Layers:
+  dataflow.py  — def-use chains, happens-before graph, live intervals
+  verifier.py  — the PTV rule engine (stable IDs, severities, suppressions)
+  contracts.py — verified-in/verified-out wrappers for the transpilers
+"""
+
+from .dataflow import (  # noqa: F401
+    dependency_graph,
+    def_use,
+    happens_before,
+    hazards,
+    sub_block_indices,
+    var_intervals,
+)
+from .verifier import (  # noqa: F401
+    Finding,
+    Report,
+    RULES,
+    VerificationError,
+    verify_program,
+)
+from . import contracts  # noqa: F401

@@ -1,0 +1,222 @@
+"""Verified-in/verified-out contracts around the four transpilers.
+
+Each wrapper verifies the program BEFORE the pass (garbage in is the
+pass author's best alibi — take it away), runs the pass, then verifies
+the result plus pass-specific postconditions:
+
+  checked_memory_optimize    — liveness diff: the remat marking may only
+                               SHRINK live intervals and projected peak
+                               (PTV012 when it extends either)
+  checked_fuse_batch_norm    — still-inference program, folds conserved
+  checked_distribute_transpile — trainer program's grad fetch targets all
+                               materialize (a dropped "send" is PTV004)
+  checked_sharding_plan      — every plan entry names a declared var
+                               (PTV013)
+
+The wrappers are also installed *inside* the transpilers behind the
+PADDLE_TPU_VERIFY=1 env gate (see `should_wrap`), so a flag flip turns
+every pass in a job into a checked pass without touching call sites.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .verifier import (Finding, Report, VerificationError,
+                       env_verify_enabled, verify_program)
+
+_local = threading.local()
+
+
+def _depth() -> int:
+    return getattr(_local, "depth", 0)
+
+
+def should_wrap() -> bool:
+    """True when a transpiler entry point should route through its checked
+    wrapper: the env gate is on and we are not already inside one."""
+    return env_verify_enabled() and _depth() == 0
+
+
+class _inside:
+    def __enter__(self):
+        _local.depth = _depth() + 1
+
+    def __exit__(self, *exc):
+        _local.depth = _depth() - 1
+        return False
+
+
+def _verify(program, stage, **kw) -> Report:
+    rep = verify_program(program, **kw)
+    rep.raise_if_errors(stage)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# memory_optimize: liveness must only shrink
+
+
+def liveness_snapshot(program, batch_size: int = 64, block_id: int = 0) -> dict:
+    """Effective (first_def, last_use) intervals + projected peak under the
+    program's CURRENT remat marking — the memory_optimize postcondition
+    baseline."""
+    from ..memory_optimization_transpiler import _lifetimes, analyze_liveness
+
+    block = program.blocks[block_id]
+    marked = [op for op in block.ops if op.attrs.get("__remat__")]
+    lt = _lifetimes(block, batch_size, marked)
+    _, peak, _ = analyze_liveness(block, batch_size, marked, lifetimes=lt)
+    first_def, last_use, sizes = lt
+    return {"first_def": dict(first_def), "last_use": dict(last_use),
+            "peak": int(peak), "n_ops": len(block.ops)}
+
+
+def liveness_diff(before: dict, program, batch_size: int = 64,
+                  block_id: int = 0) -> List[Finding]:
+    """PTV012 findings for every var whose effective live interval grew —
+    or a projected-peak regression — relative to `before`."""
+    after = liveness_snapshot(program, batch_size, block_id)
+    findings: List[Finding] = []
+    for name, lu in after["last_use"].items():
+        b_lu = before["last_use"].get(name)
+        if b_lu is not None and lu > b_lu:
+            findings.append(Finding(
+                "PTV012", f"last use moved from op {b_lu} to op {lu}",
+                block=block_id, var=name))
+    for name, fd in after["first_def"].items():
+        b_fd = before["first_def"].get(name)
+        if b_fd is not None and fd < b_fd:
+            findings.append(Finding(
+                "PTV012", f"first def moved from op {b_fd} to op {fd}",
+                block=block_id, var=name))
+    if after["peak"] > before["peak"]:
+        findings.append(Finding(
+            "PTV012", f"projected activation peak rose "
+            f"{before['peak']} -> {after['peak']} bytes", block=block_id))
+    return findings
+
+
+def checked_memory_optimize(program, level: int = 0, batch_size: int = 64,
+                            hbm_bytes: Optional[int] = None,
+                            block_id: int = 0) -> int:
+    """memory_optimize under contract; returns #ops marked (same as the
+    raw pass).  Raises VerificationError on bad input, bad output, or any
+    extended live range / peak regression."""
+    from ..memory_optimization_transpiler import memory_optimize
+
+    _verify(program, "memory_optimize:in", block_id=block_id,
+            check_shapes=False)
+    before = liveness_snapshot(program, batch_size, block_id)
+    with _inside():
+        n = memory_optimize(program, level=level, batch_size=batch_size,
+                            hbm_bytes=hbm_bytes, block_id=block_id)
+    _verify(program, "memory_optimize:out", block_id=block_id,
+            check_shapes=False)
+    bad = liveness_diff(before, program, batch_size, block_id)
+    if bad:
+        raise VerificationError("memory_optimize:liveness", bad)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# inference transpiler
+
+
+def checked_fuse_batch_norm(program, scope, block_id: int = 0,
+                            fetch_names=()) -> int:
+    """fuse_batch_norm under contract; returns #folds.  Postconditions: the
+    program still verifies, every batch_norm that folded is gone, and no
+    fold touched a declared fetch target."""
+    from ..inference_transpiler import fuse_batch_norm
+
+    fetch = list(fetch_names)
+    _verify(program, "fuse_batch_norm:in", fetch_names=fetch or None,
+            block_id=block_id, check_shapes=False)
+    n_bn_before = sum(1 for op in program.blocks[block_id].ops
+                      if op.type == "batch_norm")
+    with _inside():
+        folded = fuse_batch_norm(program, scope, block_id,
+                                 fetch_names=fetch)
+    _verify(program, "fuse_batch_norm:out", fetch_names=fetch or None,
+            block_id=block_id, check_shapes=False)
+    n_bn_after = sum(1 for op in program.blocks[block_id].ops
+                     if op.type == "batch_norm")
+    if n_bn_before - n_bn_after != folded:
+        raise VerificationError("fuse_batch_norm:out", [Finding(
+            "PTV014", f"pass reported {folded} folds but batch_norm count "
+            f"went {n_bn_before} -> {n_bn_after}", block=block_id)])
+    return folded
+
+
+# ---------------------------------------------------------------------------
+# distribute transpiler (pserver split)
+
+
+def checked_distribute_transpile(transpiler, trainer_id, program=None,
+                                 pservers: str = "", trainers: int = 1,
+                                 split_method=None, startup_program=None):
+    """DistributeTranspiler.transpile under contract.  The out-check runs
+    with fetch_names = the grad fetch list: the trainer program must still
+    materialize every gradient the pserver round expects — deleting a
+    grad-producing op (the reference's lost send op) is PTV004."""
+    from ..framework.core import default_main_program
+
+    program = program if program is not None else default_main_program()
+    _verify(program, "distribute_transpile:in", check_shapes=False)
+    with _inside():
+        result = transpiler.transpile(
+            trainer_id, program=program, pservers=pservers,
+            trainers=trainers, split_method=split_method,
+            startup_program=startup_program)
+    verify_distribute_result(transpiler)
+    return result
+
+
+def verify_distribute_result(transpiler):
+    """Out-half of the distribute contract, callable on its own against a
+    (possibly further-mutated) transpiled trainer program."""
+    grad_names = list(transpiler.param_grad.values())
+    _verify(transpiler.program, "distribute_transpile:out",
+            fetch_names=grad_names, check_shapes=False)
+    remaining = [op.type for b in transpiler.program.blocks for op in b.ops
+                 if op.type in _optimize_op_types()]
+    if remaining:
+        raise VerificationError("distribute_transpile:out", [Finding(
+            "PTV014", f"optimizer ops {remaining} survived the split — "
+            f"the pserver would double-apply updates")])
+
+
+def _optimize_op_types():
+    from ..distributed.distribute_transpiler import OPTIMIZE_OP_TYPES
+
+    return OPTIMIZE_OP_TYPES
+
+
+# ---------------------------------------------------------------------------
+# sharding (parallel) transpiler
+
+
+def checked_sharding_plan(transpiler, program, mesh) -> Dict[str, object]:
+    """parallel.DistributeTranspiler.transpile under contract: the program
+    must verify before AND be unmutated after (this transpiler assigns
+    shardings, it must not rewrite), and every plan key must name a
+    declared variable (PTV013)."""
+    _verify(program, "sharding_transpile:in", check_shapes=False)
+    version = program._version
+    with _inside():
+        plan = transpiler.transpile(program, mesh)
+    if program._version != version:
+        raise VerificationError("sharding_transpile:out", [Finding(
+            "PTV014", "sharding transpiler mutated the program (version "
+            f"{version} -> {program._version}); it must only assign specs")])
+    declared = set()
+    for b in program.blocks:
+        declared.update(b.vars)
+    bad = [Finding("PTV013", "plan assigns a sharding to an undeclared "
+                   "variable", var=n)
+           for n in plan if n not in declared]
+    if bad:
+        raise VerificationError("sharding_transpile:out", bad)
+    return plan

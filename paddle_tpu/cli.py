@@ -10,6 +10,8 @@ Usage: python -m paddle_tpu <subcommand> [args]
   stats DIR|FILE        — one JSON line of program stats (native lib)
   merge_model DIR OUT   — bundle a saved inference model into one file
   validate DIR|FILE     — structural check via the native desc library
+  lint DIR|FILE         — static dataflow verifier (analysis/verifier.py):
+                          PTV rule findings report; exit 1 on errors
   show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
   master ...            — fault-tolerant task-dispatch service
@@ -140,6 +142,46 @@ def cmd_validate(args) -> int:
     return 1
 
 
+def _load_program_any(path):
+    """(program, feed_names, fetch_names) from a saved-model dir or a raw
+    program file.  Dirs go through io.load_program_desc (the same loader
+    load_inference_model uses — __model__ preferred, program.json
+    fallback, truncation guard); raw files are sniffed: JSON vs proto."""
+    from . import io as fluid_io
+    from .framework.core import Program
+
+    if os.path.isdir(path):
+        return fluid_io.load_program_desc(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:1] == b"{":
+        program = Program.from_json(data.decode())
+        if not any(b.ops for b in program.blocks):
+            # same truncation guard as parse_program_bytes: an empty
+            # program must never lint "OK: 0 findings"
+            raise ValueError(f"{path} holds an empty program — "
+                             f"truncated save?")
+        return program, None, None
+    return fluid_io.parse_program_bytes(data, path), None, None
+
+
+def cmd_lint(args) -> int:
+    from .analysis import verify_program
+
+    program, feed, fetch = _load_program_any(args.model)
+    suppress = set()
+    for s in args.suppress or []:
+        suppress.update(p.strip() for p in s.split(",") if p.strip())
+    report = verify_program(
+        program, feed_names=feed, fetch_names=fetch,
+        batch_size=args.batch_size, suppress=suppress,
+        check_shapes=not args.no_shapes)
+    print(report.render())
+    if report.errors or (args.strict and report.warnings):
+        return 1
+    return 0
+
+
 def cmd_show_pb(args) -> int:
     from .utils import show_pb
 
@@ -209,6 +251,19 @@ def main(argv=None) -> int:
         p = sub.add_parser(name)
         p.add_argument("model", help="saved model dir or __model__ file")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("lint")
+    p.add_argument("model", help="saved model dir, __model__ file, or "
+                                 "program.json")
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="value binding -1 feed dims during abstract eval")
+    p.add_argument("--suppress", action="append", default=[],
+                   help="comma-separated PTV rule ids to silence")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on warnings too, not just errors")
+    p.add_argument("--no-shapes", action="store_true",
+                   help="skip abstract shape/dtype eval (PTV006)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("merge_model")
     p.add_argument("model_dir")

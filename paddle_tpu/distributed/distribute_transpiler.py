@@ -69,7 +69,18 @@ class DistributeTranspiler:
         transpile :76).  `pservers` is the comma-separated endpoint list;
         parameters map to endpoints by name hash (go client.go), whole-var
         (the simple-transpiler split; block-slicing a var buys nothing
-        when the update is a host-side numpy op)."""
+        when the update is a host-side numpy op).  Under
+        PADDLE_TPU_VERIFY=1 the split runs inside its verified-in/
+        verified-out contract (analysis/contracts.py): the trainer
+        program must still materialize every gradient the pserver round
+        expects."""
+        from ..analysis import contracts
+
+        if contracts.should_wrap():
+            return contracts.checked_distribute_transpile(
+                self, trainer_id, program=program, pservers=pservers,
+                trainers=trainers, split_method=split_method,
+                startup_program=startup_program)
         self.trainer_id = str(trainer_id)
         self.trainers = int(trainers)
         self.endpoints: List[str] = [e.strip() for e in pservers.split(",")

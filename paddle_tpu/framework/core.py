@@ -513,3 +513,21 @@ GRAD_SUFFIX = "@GRAD"
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+def drop_orphaned_vars(block: Block, keep=()) -> int:
+    """Delete declarations no op in `block` references (keeping persistable
+    and data vars, plus `keep` names).  Passes that remove ops (io.prune,
+    the conv+BN fold) call this so their output lints clean — the verifier
+    (analysis PTV011) rightly flags var-table debris.  Returns #dropped."""
+    referenced = set(keep)
+    for op in block.ops:
+        referenced.update(n for n in op.input_names() if n)
+        referenced.update(n for n in op.output_names() if n)
+    dropped = 0
+    for name in list(block.vars):
+        v = block.vars[name]
+        if name not in referenced and not v.persistable and not v.is_data:
+            del block.vars[name]
+            dropped += 1
+    return dropped

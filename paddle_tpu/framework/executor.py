@@ -181,6 +181,9 @@ class Executor:
         self._pin_device = True
         # FLAGS_check_nan_inf analog: per-step non-finite scan of outputs
         self.check_nan_inf = False
+        # programs already verified (analysis/verifier.py), keyed like the
+        # executable cache so re-verification only happens on mutation
+        self._verified: set = set()
 
     def optimized_hlo(self, program=None, feed=None, fetch_list=None,
                       scope=None, block_id: int = 0) -> str:
@@ -250,13 +253,26 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         block_id: int = 0,
+        verify: Optional[bool] = None,
     ):
+        """`verify`: run the static program verifier (analysis/verifier.py)
+        before execution and raise VerificationError on error findings.
+        Default None defers to the PADDLE_TPU_VERIFY=1 env gate; results
+        are cached per program version so steady-state runs pay nothing."""
         from .core import default_main_program
 
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
         scope = scope if scope is not None else global_scope()
+
+        if verify is None:
+            from ..analysis.verifier import env_verify_enabled
+
+            verify = env_verify_enabled()
+        if verify:
+            self._verify_program(program, block_id, sorted(feed),
+                                 fetch_names)
 
         block = program.blocks[block_id]
         feed_vals = self._prepare_feeds(block, feed)
@@ -370,6 +386,33 @@ class Executor:
         if return_numpy:
             return [as_numpy(fetches[n]) for n in fetch_names]
         return [fetches[n] for n in fetch_names]
+
+    # ------------------------------------------------------------------
+    def _verify_program(self, program, block_id, feed_names, fetch_names):
+        """Static pre-execution check (the TensorFlow-paper placement/
+        well-formedness validation stance): errors raise, warnings log
+        once.  One verification per (program version, feed/fetch set)."""
+        key = (program._cache_token, program._version, block_id,
+               tuple(feed_names), tuple(fetch_names))
+        if key in self._verified:
+            return
+        from ..analysis.verifier import verify_program
+
+        # no fetches this call -> no fetch CONTEXT: [] would make the
+        # dead-op rule treat every unfetched terminal op as dead weight
+        report = verify_program(program, feed_names=feed_names,
+                                fetch_names=fetch_names or None,
+                                block_id=block_id)
+        for f in report.warnings:
+            logger.warning("program verifier: %s", f.format())
+        report.raise_if_errors("Executor.run")
+        # a version bump obsoletes older entries for the same program
+        # (mirrors _load_paths: never an unbounded trail of dead keys)
+        for old in [k for k in self._verified
+                    if k[0] == program._cache_token
+                    and k[1] != program._version]:
+            self._verified.discard(old)
+        self._verified.add(key)
 
     # ------------------------------------------------------------------
     def _prepare_feeds(self, block, feed: Dict[str, object]):

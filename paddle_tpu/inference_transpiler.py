@@ -134,6 +134,10 @@ class InferenceTranspiler:
             folded += 1
         if folded:
             block.ops[:] = new_ops
+            # the removed batch_norm ops orphan their saved mean/var temps
+            from .framework.core import drop_orphaned_vars
+
+            drop_orphaned_vars(block, keep=fetch_names)
             block.program._bump()
         return folded
 
@@ -172,6 +176,13 @@ def fuse_batch_norm(program, scope, block_id: int = 0,
                     fetch_names=()) -> int:
     """Module-level convenience: InferenceTranspiler().transpile(...).
     Pass the vars you will fetch as `fetch_names` — folds that would
-    change a fetched conv output's value are skipped."""
+    change a fetched conv output's value are skipped.  Under
+    PADDLE_TPU_VERIFY=1 the fold runs inside its verified-in/verified-out
+    contract (analysis/contracts.py)."""
+    from .analysis import contracts
+
+    if contracts.should_wrap():
+        return contracts.checked_fuse_batch_norm(program, scope, block_id,
+                                                 fetch_names=fetch_names)
     return InferenceTranspiler().transpile(program, scope, block_id,
                                            fetch_names=fetch_names)

@@ -67,7 +67,10 @@ def load_persistables(executor, dirname, main_program=None, scope=None):
 
 
 def prune(program: Program, targets: List[str]) -> Program:
-    """Drop ops not needed to compute `targets` (framework/prune.cc)."""
+    """Drop ops not needed to compute `targets` (framework/prune.cc).
+    Variable declarations orphaned by the op pruning (grad vars of a
+    stripped backward pass, dead temps) go with them — a saved inference
+    model must lint clean (analysis PTV011), not carry training debris."""
     pruned = Program.from_json(program.to_json())
     block = pruned.global_block()
     needed = set(targets)
@@ -77,6 +80,9 @@ def prune(program: Program, targets: List[str]) -> Program:
             keep.append(op)
             needed.update(n for n in op.input_names() if n)
     block.ops = list(reversed(keep))
+    from .framework.core import drop_orphaned_vars
+
+    drop_orphaned_vars(block, keep=targets)
     return pruned
 
 
@@ -168,26 +174,62 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return inference_program
 
 
-def load_inference_model(dirname, executor, scope=None):
-    """io.py:301 equivalent → (program, feed_names, fetch_names)."""
+def parse_program_bytes(data: bytes, origin: str = "<bytes>") -> Program:
+    """Wire bytes -> Program with the truncation guard: an empty desc
+    parses "successfully" from corrupt/empty bytes and must be rejected,
+    not returned as a valid 0-op program."""
+    from .framework import proto_io
+
+    program = proto_io.parse_program(data)
+    if not any(b.ops for b in program.blocks):
+        raise ValueError(
+            f"{origin} holds an empty program ({len(data)} bytes) — "
+            f"truncated save?")
+    return program
+
+
+def load_program_desc(dirname):
+    """Descs only, no scope side effects: (program, feed_names,
+    fetch_names) from a saved model dir.  Prefers the protobuf
+    `__model__`, falling back to `program.json` (saves made without the
+    protoc toolchain); feed/fetch names are None when meta.json is
+    absent (a bare program dump).  Shared by load_inference_model and
+    the `paddle_tpu lint` CLI so the two can never drift."""
     model_path = os.path.join(dirname, "__model__")
     if os.path.exists(model_path):
-        from .framework import proto_io
-
         with open(model_path, "rb") as f:
-            data = f.read()
-        program = proto_io.parse_program(data)
-        if not any(b.ops for b in program.blocks):
-            raise ValueError(
-                f"{model_path} holds an empty program "
-                f"({len(data)} bytes) — truncated save?")
+            program = parse_program_bytes(f.read(), model_path)
     else:
-        with open(os.path.join(dirname, "program.json")) as f:
+        json_path = os.path.join(dirname, "program.json")
+        with open(json_path) as f:
             program = Program.from_json(f.read())
-    with open(os.path.join(dirname, "meta.json")) as f:
+        if not any(b.ops for b in program.blocks):
+            # same truncation guard as the proto path: a 0-op "model"
+            # is a broken save, not a cleanly-lintable program
+            raise ValueError(f"{json_path} holds an empty program — "
+                             f"truncated save?")
+    meta_path = os.path.join(dirname, "meta.json")
+    if not os.path.exists(meta_path):
+        return program, None, None
+    with open(meta_path) as f:
         meta = json.load(f)
+    return (program, meta.get("feed_var_names"),
+            meta.get("fetch_var_names"))
+
+
+def load_inference_model(dirname, executor, scope=None):
+    """io.py:301 equivalent → (program, feed_names, fetch_names)."""
+    meta_path = os.path.join(dirname, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"{meta_path} missing — not a saved inference model")
+    program, feed_names, fetch_names = load_program_desc(dirname)
+    if feed_names is None or fetch_names is None:
+        raise KeyError(
+            f"{meta_path} lacks feed_var_names/fetch_var_names — "
+            f"corrupt or foreign meta file")
     load_persistables(executor, dirname, scope=scope)
-    return program, meta["feed_var_names"], meta["fetch_var_names"]
+    return program, feed_names, fetch_names
 
 
 def merge_model(model_dir, out_path):

@@ -173,7 +173,17 @@ def memory_optimize(program: Program, level: int = 0,
     hbm_bytes: explicit budget; defaults to the device's reported
     capacity (memory.total()), then $PADDLE_TPU_HBM_BYTES, then 16 GiB.
     batch_size binds -1 feed dims in the projection.
+
+    Under PADDLE_TPU_VERIFY=1 the pass runs inside its verified-in/
+    verified-out contract (analysis/contracts.py): program checked before
+    and after, and the marking must provably not extend any live range.
     """
+    from .analysis import contracts
+
+    if contracts.should_wrap():
+        return contracts.checked_memory_optimize(
+            program, level=level, batch_size=batch_size,
+            hbm_bytes=hbm_bytes, block_id=block_id)
     block = program.blocks[block_id]
     if level >= 1:
         n = 0

@@ -176,7 +176,7 @@ class ParallelExecutor(Executor):
             scope.set(n, jax.device_put(v, target))
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, block_id=0):
+            return_numpy=True, block_id=0, verify=None):
         from ..framework.core import default_main_program
 
         program = program if program is not None else default_main_program()
@@ -191,7 +191,7 @@ class ParallelExecutor(Executor):
         self._distribute_state(
             program, scope, [n for n in names if scope.has(n)])
         return super().run(program, feed, fetch_list, scope, return_numpy,
-                           block_id)
+                           block_id, verify=verify)
 
     # ------------------------------------------------------------------
     def _compile(self, program, block_id, feed_vals, fetch_names):

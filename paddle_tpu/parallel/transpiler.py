@@ -74,6 +74,12 @@ class DistributeTranspiler:
         self.rules = rules or ShardingRules()
 
     def transpile(self, program, mesh) -> Dict[str, object]:
+        from ..analysis import contracts
+
+        if contracts.should_wrap():
+            # verified-in/verified-out (PADDLE_TPU_VERIFY=1): program must
+            # verify, stay unmutated, and every plan key must be declared
+            return contracts.checked_sharding_plan(self, program, mesh)
         from jax.sharding import NamedSharding
 
         block = program.global_block()

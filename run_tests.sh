@@ -6,6 +6,11 @@ cd "$(dirname "$0")"
 
 ./build_native.sh
 
+# fast lint tier: repo hygiene + the program verifier end-to-end over two
+# saved book models (docs/analysis.md) — fails in seconds, before pytest
+python tools/repo_lint.py
+JAX_PLATFORMS=cpu python tools/lint_smoke.py
+
 python -m pytest tests/ -q "$@"
 
 # two-process multi-host smoke (jax.distributed + global-mesh

@@ -65,7 +65,22 @@ class OpTestHarness:
             out_vars[slot] = vs[0]
         block.append_op(self.op_type, inputs=in_desc, outputs=out_desc,
                         attrs=dict(self.attrs))
+        self._verify(prog)
         return prog, in_desc, out_vars
+
+    def _verify(self, prog):
+        """Every op test also exercises the program verifier
+        (analysis/verifier.py) on the program it builds — ~190 op configs
+        of free false-positive coverage for the rule engine, and a static
+        gate that the single-op program is well-formed before it runs.
+        No fetch context here: sink outputs are the point of these
+        programs, so dead-op analysis (PTV010) self-disables."""
+        from paddle_tpu.analysis import verify_program
+
+        report = verify_program(prog)
+        assert not report.errors, (
+            f"op_test program for {self.op_type!r} failed verification:\n"
+            f"{report.render()}")
 
     def _scope_feed(self, scope, overrides=None):
         import jax.numpy as jnp

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
+from _native_isolation import isolated_native
 from paddle_tpu.models import transformer
 
 
@@ -407,6 +408,7 @@ def test_lm_prefill_flash_matches_dense():
                                atol=1e-6)
 
 
+@isolated_native("transformer_fsdp")
 def test_lm_trains_dp_sp_fsdp():
     """The LM under dp×sp WITH ZeRO-3 param sharding: fsdp composes with
     the zigzag flash ring (params 1/dp, sequence axis sharded)."""

@@ -1,0 +1,76 @@
+"""Fast CI lint tier: build + save two book models, lint the saved dirs.
+
+Exercises the full `paddle_tpu lint` path end-to-end (save_inference_model
+-> proto_io/program.json load -> verifier report) on fit-a-line and
+recognize-digits, the two canonical book programs.  Exit 0 iff both lint
+clean.  Runs on CPU in a few seconds; wired into run_tests.sh before the
+pytest tiers so a verifier/CLI regression fails fast.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python tools/lint_smoke.py` from any cwd
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _save_fit_a_line(d):
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+
+
+def _save_recognize_digits(d):
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    img = fluid.layers.data(name="img", shape=[1, 28, 28])
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                            bias_attr=False)
+    b = fluid.layers.batch_norm(c, act="relu")
+    p = fluid.layers.pool2d(b, pool_size=2, pool_stride=2)
+    flat = fluid.layers.reshape(p, [-1, 8 * 12 * 12])
+    pred = fluid.layers.fc(flat, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                  fold_batch_norm=True)
+
+
+def main() -> int:
+    from paddle_tpu import cli
+
+    rc = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, builder in (("fit_a_line", _save_fit_a_line),
+                              ("recognize_digits", _save_recognize_digits)):
+            d = os.path.join(tmp, name)
+            builder(d)
+            print(f"== paddle_tpu lint {name}")
+            r = cli.main(["lint", d])
+            if r:
+                print(f"lint_smoke: {name} FAILED (rc={r})",
+                      file=sys.stderr)
+            rc = rc or r
+    if not rc:
+        print("lint_smoke: OK (2 models)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Repository hygiene lint (the fast CI tier in run_tests.sh).
+
+Two classes of rot this repo has actually accumulated:
+
+  1. orphaned bytecode — a ``__pycache__/*.pyc`` whose source module was
+     deleted (paddle_tpu/observability/ shipped exactly this: sources
+     removed, compiled ghosts left importable-looking);
+  2. packages missing ``__init__.py`` — a directory of .py modules under
+     the package tree that Python will not treat as a package.
+
+Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
+any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
+test trees are intentionally not packages).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# directory names whose contents are never package code
+_SKIP_DIRS = {".git", "__pycache__", "node_modules", ".venv"}
+# top-level trees exempt from the missing-__init__ rule
+_NO_INIT_OK = {"tests", "docs"}
+
+
+def _source_for(pyc_name: str) -> str:
+    """foo.cpython-310.pyc -> foo.py (also plain foo.pyc)."""
+    base = pyc_name.split(".")[0]
+    return base + ".py"
+
+
+def lint(root: str):
+    findings = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        parts = [] if rel == "." else rel.split(os.sep)
+        if any(p in _SKIP_DIRS and p != "__pycache__" for p in parts):
+            dirnames[:] = []
+            continue
+        if os.path.basename(dirpath) == "__pycache__":
+            src_dir = os.path.dirname(dirpath)
+            for f in filenames:
+                if not f.endswith(".pyc"):
+                    continue
+                src = os.path.join(src_dir, _source_for(f))
+                if not os.path.exists(src):
+                    findings.append(
+                        f"orphaned bytecode: {os.path.join(rel, f)} "
+                        f"(no {_source_for(f)} beside it)")
+            # a __pycache__ whose parent has no sources at all is a dead
+            # package directory
+            if not any(n.endswith(".py") for n in os.listdir(src_dir)):
+                findings.append(
+                    f"dead package dir: {os.path.relpath(src_dir, root)} "
+                    f"(only __pycache__, no sources)")
+            dirnames[:] = []
+            continue
+        if parts and parts[0] in _NO_INIT_OK:
+            continue
+        has_py = any(f.endswith(".py") for f in filenames)
+        is_pkg_member = parts and any(
+            os.path.exists(os.path.join(root, *parts[:i + 1],
+                                        "__init__.py"))
+            for i in range(len(parts)))
+        if has_py and parts and "__init__.py" not in filenames \
+                and is_pkg_member:
+            findings.append(
+                f"package missing __init__.py: {rel} (contains .py "
+                f"modules inside a package tree)")
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    findings = lint(root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"repo_lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("repo_lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
