@@ -775,7 +775,7 @@ def main():
             # headline preference order: the resnet headline if cached,
             # else ANY cached mode — partial cached evidence must still
             # beat an error-only artifact
-            order = ("resnet", "lstm", "infer", "gpt", "gpt_gen")
+            order = ("resnet", "lstm", "infer", "gpt", "gpt_gen", "serve")
             avail = [k for k in order if k in cached]
             if avail:
                 headline = cached[avail[0]]

@@ -418,7 +418,7 @@ def _check_shapes(program, block_id, batch_size):
     poisons its outputs with _UNKNOWN — the rule never guesses."""
     import jax
 
-    from ..framework.core import canonical_dtype
+    from ..framework.core import canonical_dtype, np_dtype
     from ..framework.executor import _lower_ops
     from ..ops.registry import EmitContext, get_op_info
 
@@ -499,6 +499,19 @@ def _check_shapes(program, block_id, batch_size):
                     try:
                         declared = canonical_dtype(v.dtype)
                         inferred = canonical_dtype(str(got.dtype))
+                        if declared != inferred:
+                            # mirror the runtime: under jax's default
+                            # 32-bit mode EVERY int64/float64-declared
+                            # emitter output is truncated (gpt_decode's
+                            # Ids, the serving NextToken, argmax ops...)
+                            # — compare against what the executor would
+                            # actually produce, not the nominal width
+                            import numpy as _np
+                            from jax import dtypes as _jd
+
+                            declared = canonical_dtype(str(
+                                _jd.canonicalize_dtype(_np.dtype(
+                                    np_dtype(declared)))))
                     except Exception:
                         continue
                     if declared != inferred:

@@ -199,6 +199,82 @@ class DecoderLM:
         )
         return ids, scores
 
+    # ------------------------------------------------------------------
+    # Incremental serving path: paged KV cache, one engine step per op.
+    # generate() above (the fused whole-loop gpt_decode) and the training
+    # tower remain the parity oracles for these — tests/test_serving.py
+    # asserts the paged step-at-a-time decode reproduces the full-prefix
+    # tower argmax exactly.
+
+    def declare_kv_cache(self, num_pages, page_size, name="paged_kv"):
+        """Declare the paged K/V pool variables [L, num_pages, nh, ps, dh]
+        in the CURRENT program and return them as the `cache` pair.
+
+        The pools are persistable state: their VALUES live in the scope
+        under these names, so the serving engine's prefill and decode
+        programs (each declaring the same names) share one physical
+        cache, exactly like parameters are shared between the tower and
+        generation programs."""
+        from ..framework.core import default_main_program
+
+        dh = self.dim // self.n_heads
+        shape = (self.n_layers, int(num_pages), self.n_heads,
+                 int(page_size), dh)
+        gb = default_main_program().global_block()
+        mk = lambda s: gb.create_var(
+            name=f"{name}.{s}", shape=shape, dtype=self.dtype,
+            persistable=True, stop_gradient=True)
+        return mk("k"), mk("v")
+
+    def prefill(self, prompt, prompt_len, page_table, cache, page_size):
+        """Append a paged_prefill op: write the prompt's K/V into `cache`
+        through `page_table` and return the first greedy token [B] int64.
+        prompt [B,P,1] is bucket-padded; prompt_len [B,1] carries the
+        real lengths (ragged batches prefill together)."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        kpool, vpool = cache
+        helper = LayerHelper("paged_prefill")
+        tok = helper.create_tmp_variable("int64", shape=(-1,),
+                                         stop_gradient=True)
+        ins = self._decode_inputs(prompt)
+        ins.update({"PromptLen": [prompt_len.name],
+                    "PageTable": [page_table.name],
+                    "KPool": [kpool.name], "VPool": [vpool.name]})
+        helper.append_op(
+            "paged_prefill", inputs=ins,
+            outputs={"NextToken": [tok.name], "KPoolOut": [kpool.name],
+                     "VPoolOut": [vpool.name]},
+            attrs={"n_heads": self.n_heads, "page_size": int(page_size),
+                   "eps": 1e-5})
+        return tok
+
+    def decode_step(self, cache, token, ctx_len, active, page_table,
+                    page_size):
+        """Append ONE paged decode step: feed `token` [B,1] (written into
+        the cache at position ctx_len), attend over each slot's paged
+        context, return the next greedy token [B] int64.  The host loop
+        (serving/engine.py) owns admission/eviction between steps —
+        contrast generate(), which compiles the whole loop into one op
+        and cannot rebatch mid-flight."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        kpool, vpool = cache
+        helper = LayerHelper("paged_decode_step")
+        tok = helper.create_tmp_variable("int64", shape=(-1,),
+                                         stop_gradient=True)
+        ins = self._decode_inputs(token)
+        ins.update({"CtxLen": [ctx_len.name], "Active": [active.name],
+                    "PageTable": [page_table.name],
+                    "KPool": [kpool.name], "VPool": [vpool.name]})
+        helper.append_op(
+            "paged_decode_step", inputs=ins,
+            outputs={"NextToken": [tok.name], "KPoolOut": [kpool.name],
+                     "VPoolOut": [vpool.name]},
+            attrs={"n_heads": self.n_heads, "page_size": int(page_size),
+                   "eps": 1e-5})
+        return tok
+
     def _decode_inputs(self, prompt):
         """Wire the recorded tower parameters into a decode op's slots,
         declaring them in the current program (see generate())."""

@@ -163,6 +163,187 @@ def scaled_dot_product_attention(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+# ---------------------------------------------------------------------------
+# Serving tier: paged KV-cache prefill + single-token decode step
+# (paddle_tpu/serving/).  Unlike gpt_decode — which fuses prefill plus the
+# WHOLE generation loop into one op — these two ops expose exactly one
+# engine iteration each, so a host-side continuous-batching scheduler can
+# admit/evict requests between steps.  The K/V pools ride the executor's
+# read-then-written state idiom (input slot KPool and output slot KPoolOut
+# name the SAME variable): donated, updated in place, persisted in the
+# scope across the prefill and decode programs.
+
+
+def _squeeze_feed(x, dtype):
+    """[N,1] or [N] host feed -> [N] in `dtype` (layers.data always carries
+    a trailing payload dim; emitters want flat vectors)."""
+    import jax.numpy as jnp
+
+    if x.ndim == 2:
+        x = x[:, 0]
+    return x.astype(dtype)
+
+
+def _paged_pools_write(pool, layer, pages, offsets, values):
+    """Scatter per-position K or V rows into the paged pool.
+
+    pool [L,P,nh,ps,dh]; pages/offsets [M] int32 (physical page and
+    in-page slot per position); values [M,nh,dh].  Mixed advanced
+    indexing (index arrays at the page and slot dims, slices between)
+    moves the indexed axes to the front, which is exactly values' layout.
+    Duplicate (page, offset) pairs only ever target the reserved null
+    page 0 (prompt pad tail, inactive slots), where any winner is fine."""
+    return pool.at[layer, pages, :, offsets, :].set(values)
+
+
+@register_op("paged_prefill", grad=None,
+             non_diff_inputs=("Tokens", "PromptLen", "PageTable"))
+def paged_prefill(ctx, ins, attrs):
+    """Prompt prefill into the paged KV pools + first greedy token.
+
+    Inputs: Tokens [N,P,1] int64 (bucket-padded prompts), PromptLen [N,1]
+    (valid lengths — causal attention makes the pad tail invisible to
+    every position < len), PageTable [N,maxp] (logical block -> physical
+    page; unallocated entries are 0, the reserved null page, so pad-tail
+    writes land in garbage space), KPool/VPool [L,num_pages,nh,ps,dh],
+    plus the gpt_decode parameter slots.  Attrs: n_heads, page_size, eps.
+    Outputs: NextToken [N] int64 (argmax of each row's last-prompt-
+    position logits), KPoolOut/VPoolOut (the input pools with the
+    prompt's K/V written through).
+
+    Positions >= PromptLen write garbage K/V into the request's own pages
+    (or the null page); that is safe by construction — decode masks
+    context to ctx_len and overwrites slot ctx_len before attending to
+    it, so a slot is always rewritten before it becomes visible."""
+    import jax
+    import jax.numpy as jnp
+
+    from .transformer_ops import _flash_ok, _lm_fns, _prompt_2d
+
+    nh = int(attrs["n_heads"])
+    ps = int(attrs["page_size"])
+    eps = float(attrs.get("eps", 1e-5))
+
+    tokens = _prompt_2d(ins)  # [N,P] int32
+    plen = _squeeze_feed(ins["PromptLen"][0], jnp.int32)
+    pt = ins["PageTable"][0].astype(jnp.int32)  # [N,maxp]
+    kpool, vpool = ins["KPool"][0], ins["VPool"][0]
+
+    fns = _lm_fns(ins, nh, eps)
+    emb, pos = ins["Emb"][0], fns.pos
+    cdt = emb.dtype
+    scale = 1.0 / (fns.dh ** 0.5)
+    N, P = tokens.shape
+    use_flash = _flash_ok(ctx, P, fns)
+    if not use_flash:
+        causal = jnp.tril(jnp.ones((P, P), bool))
+
+    per_layer = []  # (k, v) heads-layout [N,nh,P,dh] per layer
+
+    def attend(i, q, k, v):
+        per_layer.append((k, v))
+        if use_flash:
+            from .pallas_kernels.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True, scale=scale)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+            jnp.float32) * scale
+        s = jnp.where(causal, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    x = emb[tokens] + pos[:P].astype(cdt)
+    for i in range(fns.L):
+        x = fns.block(i, x, attend)
+
+    # each row's last REAL position (head_logits reads position -1, so
+    # gather first): [N,1,D]
+    last = jnp.take_along_axis(
+        x, (plen - 1).astype(jnp.int32)[:, None, None], axis=1)
+    first = jnp.argmax(fns.head_logits(last), axis=-1).astype(jnp.int64)
+
+    # scatter every prompt position's K/V into its page: position p ->
+    # physical page pt[n, p // ps], in-page slot p % ps
+    p_idx = jnp.arange(P, dtype=jnp.int32)
+    pages = pt[:, p_idx // ps].reshape(-1)  # [N*P]
+    offs = jnp.broadcast_to(p_idx % ps, (N, P)).reshape(-1)
+    for i, (k, v) in enumerate(per_layer):
+        rows = lambda a: a.transpose(0, 2, 1, 3).reshape(N * P, nh, fns.dh)
+        kpool = _paged_pools_write(kpool, i, pages, offs, rows(k))
+        vpool = _paged_pools_write(vpool, i, pages, offs, rows(v))
+    return {"NextToken": [first], "KPoolOut": [kpool],
+            "VPoolOut": [vpool]}
+
+
+@register_op("paged_decode_step", grad=None,
+             non_diff_inputs=("Tokens", "CtxLen", "Active", "PageTable"))
+def paged_decode_step(ctx, ins, attrs):
+    """ONE continuous-batching decode step over the paged KV cache.
+
+    Inputs: Tokens [N,1] int64 (the token each slot feeds this step — not
+    yet in the cache; this op writes its K/V at position CtxLen), CtxLen
+    [N,1] (tokens already cached per slot), Active [N,1] (0/1 — inactive
+    slots write to the null page and emit token 0), PageTable [N,maxp],
+    KPool/VPool, plus the gpt_decode parameter slots.  Attrs: n_heads,
+    page_size, eps.  Outputs: NextToken [N] int64 (greedy argmax),
+    KPoolOut/VPoolOut.
+
+    Attention runs the Pallas ragged paged-attention kernel when eligible
+    (pallas_kernels/paged_attention.py gate) and its pure-JAX reference
+    otherwise — identical contract, tested for parity."""
+    import jax.numpy as jnp
+
+    from .pallas_kernels import paged_attention as pa
+    from .transformer_ops import _lm_fns
+
+    nh = int(attrs["n_heads"])
+    ps = int(attrs["page_size"])
+    eps = float(attrs.get("eps", 1e-5))
+
+    tok = _squeeze_feed(ins["Tokens"][0], jnp.int32)
+    ctxl = _squeeze_feed(ins["CtxLen"][0], jnp.int32)
+    act = _squeeze_feed(ins["Active"][0], jnp.int32) > 0
+    pt = ins["PageTable"][0].astype(jnp.int32)
+    kpool, vpool = ins["KPool"][0], ins["VPool"][0]
+
+    fns = _lm_fns(ins, nh, eps)
+    emb = ins["Emb"][0]
+    cdt = emb.dtype
+    scale = 1.0 / (fns.dh ** 0.5)
+    use_kernel = pa.paged_dispatch_ok(ctx, page_size=ps, head_dim=fns.dh)
+
+    # the new token's physical write slot; inactive lanes land in the
+    # reserved null page 0 (their page-table rows are zeroed anyway)
+    page = jnp.take_along_axis(pt, (ctxl // ps)[:, None], axis=1)[:, 0]
+    page = jnp.where(act, page, 0)
+    off = ctxl % ps
+    attend_len = ctxl + 1  # context including the token written this step
+
+    xt = emb[tok][:, None, :] + jnp.take(fns.pos, ctxl, axis=0).astype(
+        cdt)[:, None, :]  # [N,1,D]
+
+    # pools thread through the layer walk as the carried arrays (the
+    # gpt_decode pattern: scatter chains XLA aliases in place on the
+    # donated buffers)
+    hold = {"k": kpool, "v": vpool}
+
+    def attend(i, q, k, v):
+        hold["k"] = _paged_pools_write(hold["k"], i, page, off, k[:, :, 0])
+        hold["v"] = _paged_pools_write(hold["v"], i, page, off, v[:, :, 0])
+        fn = pa.paged_attention if use_kernel else pa.paged_attention_ref
+        out = fn(q[:, :, 0], hold["k"][i], hold["v"][i], pt, attend_len,
+                 scale=scale)
+        return out[:, :, None, :]
+
+    x = xt
+    for i in range(fns.L):
+        x = fns.block(i, x, attend)
+    nxt = jnp.argmax(fns.head_logits(x), axis=-1).astype(jnp.int32)
+    nxt = jnp.where(act, nxt, 0).astype(jnp.int64)
+    return {"NextToken": [nxt], "KPoolOut": [hold["k"]],
+            "VPoolOut": [hold["v"]]}
+
+
 @register_op("attention_gru_cell", grad=None, non_diff_inputs=("EncLength",
                                                                "Tokens"))
 def attention_gru_cell(ctx, ins, attrs):

@@ -6,3 +6,4 @@ Pallas covers the remaining custom fusions. Kernels run `interpret=True`
 off-TPU so tests validate the same code path the chip runs."""
 
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

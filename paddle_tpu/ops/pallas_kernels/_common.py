@@ -17,6 +17,18 @@ def vmem():
     return pltpu.VMEM
 
 
+def compiler_params(**kw):
+    """pltpu.CompilerParams across jax renames: newer releases call the
+    class TPUCompilerParams (and older ones only CompilerParams) — every
+    kernel routes through here so one toolchain bump can't break all
+    pallas_call sites at once."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def step_mask(lengths, T, dtype):
     """[B] lengths -> [B,T] {0,1} mask in `dtype`."""
     import jax.numpy as jnp

@@ -162,6 +162,8 @@ def _fwd_grid(B, H, T, D, bq, bk, causal, with_lse, dtype, interpret,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from ._common import compiler_params as _pk_compiler_params
+
     nk = T // bk
 
     if causal:
@@ -196,7 +198,7 @@ def _fwd_grid(B, H, T, D, bq, bk, causal, with_lse, dtype, interpret,
         # dimension — on a Megacore part a "parallel" i could split that
         # block's writeback across cores and clobber slices, so i must be
         # sequential ("arbitrary") whenever the lse output exists
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_pk_compiler_params(
             dimension_semantics=(
                 "parallel", "arbitrary" if with_lse else "parallel",
                 "arbitrary")),
@@ -352,6 +354,8 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from ._common import compiler_params as _pk_compiler_params
+
     B, H, T, D = q.shape
     bq, bk = _snap_blocks(block_q, block_k, T, interpret)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
@@ -392,7 +396,7 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_pk_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse3, delta3)
@@ -419,7 +423,7 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, scale=None,
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_pk_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse3, delta3)

@@ -133,7 +133,11 @@ def _lm_fns(ins, nh: int, eps: float):
             x = block(i, x, attend)
         return head_logits(x), hold["k"], hold["v"]
 
+    # block/head_logits exposed for the serving ops (attention_ops
+    # paged_prefill / paged_decode_step), which walk the layers with their
+    # own paged-cache attend instead of the contiguous-cache ones above
     return SimpleNamespace(prefill=prefill, decode_step=decode_step,
+                           block=block, head_logits=head_logits,
                            L=L, D=D, dh=dh, pos=pos)
 
 

@@ -1,0 +1,21 @@
+"""Serving tier: paged KV-cache LLM inference with continuous batching.
+
+The training stack compiles whole programs (framework/executor.py); this
+package composes it into a long-lived *service* in the TensorFlow-paper
+sense — a shared device, a request queue, and an engine loop:
+
+  kv_cache.py  — fixed page pool + per-slot page tables (the allocator;
+                 page 0 is the reserved null page)
+  scheduler.py — FIFO continuous batching: admit requests into free
+                 decode slots, evict finished ones, free their pages
+  engine.py    — ServingEngine: builds the paged prefill/decode programs
+                 over a DecoderLM and runs one Executor step per engine
+                 iteration
+
+Benchmarked by tools/serve_bench.py; documented in docs/serving.md.
+"""
+
+from .engine import ServingEngine  # noqa: F401
+from .kv_cache import (PageAllocator, PagedKVCache,  # noqa: F401
+                       page_size_from_env, pages_needed)
+from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401

@@ -1,0 +1,274 @@
+"""ServingEngine: a DecoderLM behind the Executor as a long-lived service.
+
+One engine owns:
+
+  * a fixed set of DECODE SLOTS (max_batch_size) — one compiled decode
+    program of static shape [num_slots, ...] runs EVERY step regardless
+    of occupancy (inactive slots are masked), so steady-state serving is
+    one XLA invocation per token across the whole batch;
+  * a paged KV cache (kv_cache.py) whose pools live in the scope as
+    persistable state, donated in and out of each step's executable —
+    the cache never leaves HBM;
+  * PREFILL programs, one per prompt-length bucket (next power of two),
+    compiled lazily on first use and cached by the Executor thereafter;
+  * a ContinuousBatchingScheduler deciding, between steps, which waiting
+    requests take freed slots and which finished ones release pages.
+
+The engine iteration (`step()`):
+  1. admit: scheduler moves queue-head requests into free slots; each is
+     prefilled (bucket-padded, ragged lengths fine) and its first token
+     recorded;
+  2. decode: one paged_decode_step over all slots; active slots append
+     their token, requests hitting eos/max_new are evicted.
+
+Everything on-device is deterministic greedy argmax, so the engine's
+output must exactly reproduce the full-prefix tower oracle — that is the
+serving correctness contract tests/test_serving.py enforces.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .kv_cache import PagedKVCache, page_size_from_env, pages_needed
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+def _bucket_of(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServingEngine:
+    def __init__(self, lm, max_batch_size: int = 8,
+                 num_pages: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 eos_id: int = -1,
+                 max_prefill_per_step: int = 4,
+                 place=None, clock=time.monotonic):
+        """`lm` is a DecoderLM whose tower is already built (.logits())
+        and whose parameters are initialized in the global scope (the
+        startup program ran).  `num_pages` defaults to enough for every
+        slot at max_len simultaneously (+ the null page); pass something
+        smaller to actually exercise queueing under page pressure."""
+        from .. import layers
+        from ..framework import unique_name
+        from ..framework.core import Program, np_dtype, program_guard
+        from ..framework.executor import Executor
+        from ..framework.place import default_place
+        from ..framework.scope import global_scope
+
+        if lm._params is None:
+            raise RuntimeError("build the model tower with .logits() "
+                               "before constructing a ServingEngine")
+        self.lm = lm
+        self.eos_id = int(eos_id)
+        self.num_slots = int(max_batch_size)
+        self.page_size = int(page_size if page_size is not None
+                             else page_size_from_env())
+        self.max_pages = pages_needed(lm.max_len, self.page_size)
+        self.num_pages = int(num_pages if num_pages is not None
+                             else self.num_slots * self.max_pages + 1)
+        self._clock = clock
+        self._scope = global_scope()
+
+        self.cache = PagedKVCache(self.num_slots, self.max_pages,
+                                  self.num_pages, self.page_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, max_prefill_per_step=max_prefill_per_step)
+
+        self._exe = Executor(place if place is not None else default_place())
+        self._pfx = unique_name.generate("serve")
+        self._cache_name = f"{self._pfx}.kv"
+
+        # decode program: fixed [num_slots] shape, compiled once
+        self._decode_prog = Program()
+        with program_guard(self._decode_prog):
+            tok = layers.data(f"{self._pfx}.tok", shape=[1], dtype="int64")
+            ctx = layers.data(f"{self._pfx}.ctx", shape=[1], dtype="int64")
+            act = layers.data(f"{self._pfx}.act", shape=[1], dtype="int64")
+            pt = layers.data(f"{self._pfx}.pt", shape=[self.max_pages],
+                             dtype="int64")
+            cache_vars = lm.declare_kv_cache(self.num_pages, self.page_size,
+                                             name=self._cache_name)
+            self._decode_fetch = lm.decode_step(
+                cache_vars, tok, ctx, act, pt, self.page_size)
+
+        # the pools themselves: zero-initialized persistable scope state
+        # (page 0 = null page); device_put + donation keep them in HBM
+        dh = lm.dim // lm.n_heads
+        pool_shape = (lm.n_layers, self.num_pages, lm.n_heads,
+                      self.page_size, dh)
+        dt = np_dtype(lm.dtype)
+        self._scope.set(f"{self._cache_name}.k", np.zeros(pool_shape, dt))
+        self._scope.set(f"{self._cache_name}.v", np.zeros(pool_shape, dt))
+
+        self._prefill_progs: Dict[int, tuple] = {}  # bucket -> (prog, fetch)
+        self.finished: Dict[int, Request] = {}
+        self._steps = 0
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               arrival: Optional[float] = None) -> int:
+        """Queue one request; returns its id (see .finished after run()).
+        `arrival` (engine-clock timestamp) defaults to now — an open-loop
+        load generator passes the SCHEDULED arrival instead, so queueing
+        delay spent blocked behind an in-flight step still counts in the
+        reported latency."""
+        if len(prompt) + int(max_new_tokens) > self.lm.max_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
+                f"exceeds model max_len={self.lm.max_len}")
+        req = Request(prompt, max_new_tokens,
+                      arrival=self._clock() if arrival is None else arrival)
+        self.scheduler.submit(req)
+        return req.rid
+
+    def outstanding(self) -> int:
+        return self.scheduler.outstanding()
+
+    # ------------------------------------------------------------------
+    def _prefill_program(self, bucket: int):
+        from .. import layers
+        from ..framework.core import Program, program_guard
+
+        entry = self._prefill_progs.get(bucket)
+        if entry is not None:
+            return entry
+        prog = Program()
+        with program_guard(prog):
+            prompt = layers.data(f"{self._pfx}.prompt{bucket}",
+                                 shape=[bucket, 1], dtype="int64")
+            plen = layers.data(f"{self._pfx}.plen{bucket}", shape=[1],
+                               dtype="int64")
+            pt = layers.data(f"{self._pfx}.ppt{bucket}",
+                             shape=[self.max_pages], dtype="int64")
+            cache_vars = self.lm.declare_kv_cache(
+                self.num_pages, self.page_size, name=self._cache_name)
+            fetch = self.lm.prefill(prompt, plen, pt, cache_vars,
+                                    self.page_size)
+        entry = (prog, fetch)
+        self._prefill_progs[bucket] = entry
+        return entry
+
+    def _prefill(self, reqs: List[Request]):
+        """Prefill newly admitted requests, one bucket batch at a time
+        (ragged lengths share a bucket; each distinct bucket is its own
+        compiled program).  The batch dim is PADDED to a fixed group size
+        — the executor caches executables per feed shape, so without the
+        pad every distinct admission count would compile a fresh
+        executable mid-serving; dummy rows carry plen=1 and an all-null
+        page table, so their garbage lands in the null page and their
+        first token is discarded."""
+        by_bucket: Dict[int, List[Request]] = {}
+        for r in reqs:
+            # cap at max_len: the position table has max_len rows, and a
+            # power-of-two bucket above it would slice past them (any
+            # admitted prompt fits, since submit() enforces
+            # prompt + max_new <= max_len)
+            b = min(_bucket_of(len(r.prompt)), self.lm.max_len)
+            by_bucket.setdefault(b, []).append(r)
+        # admit() can never return more than this many
+        cap = min(self.scheduler.max_prefill_per_step, self.num_slots)
+        for bucket, group in sorted(by_bucket.items()):
+            prog, fetch = self._prefill_program(bucket)
+            # pad to the next power of two <= cap: at most log2(cap)+1
+            # cached executables per bucket, without a multi-bucket wave
+            # paying cap-row tower forwards for every 1-request group
+            G = 1
+            while G < len(group):
+                G *= 2
+            G = min(G, cap)
+            toks = np.zeros((G, bucket, 1), np.int64)
+            plen = np.ones((G, 1), np.int64)
+            pts = np.zeros((G, self.max_pages), np.int64)
+            for i, r in enumerate(group):
+                toks[i, :len(r.prompt), 0] = r.prompt
+                plen[i, 0] = len(r.prompt)
+                pts[i] = self.cache.page_table[r.slot]
+            (first,) = self._exe.run(
+                prog,
+                feed={f"{self._pfx}.prompt{bucket}": toks,
+                      f"{self._pfx}.plen{bucket}": plen,
+                      f"{self._pfx}.ppt{bucket}": pts},
+                fetch_list=[fetch])
+            now = self._clock()
+            for i, r in enumerate(group):
+                r.ctx_len = len(r.prompt)
+                r.first_token_t = now
+                self._record_token(r, int(np.asarray(first)[i]), now)
+
+    def _record_token(self, req: Request, token: int, now: float):
+        req.generated.append(token)
+        done = (len(req.generated) >= req.max_new_tokens
+                or (self.eos_id >= 0 and token == self.eos_id))
+        if done:
+            self.scheduler.finish(req, now=now)
+            self.finished[req.rid] = req
+
+    def _decode(self):
+        if not self.scheduler.active:
+            return
+        N = self.num_slots
+        tok = np.zeros((N, 1), np.int64)
+        ctx = np.zeros((N, 1), np.int64)
+        act = np.zeros((N, 1), np.int64)
+        for slot, r in self.scheduler.active.items():
+            tok[slot, 0] = r.generated[-1]
+            ctx[slot, 0] = r.ctx_len
+            act[slot, 0] = 1
+        (nxt,) = self._exe.run(
+            self._decode_prog,
+            feed={f"{self._pfx}.tok": tok, f"{self._pfx}.ctx": ctx,
+                  f"{self._pfx}.act": act,
+                  f"{self._pfx}.pt": self.cache.page_table_i64()},
+            fetch_list=[self._decode_fetch])
+        nxt = np.asarray(nxt)
+        now = self._clock()
+        # snapshot: finish() mutates scheduler.active during the walk
+        for slot, r in list(self.scheduler.active.items()):
+            r.ctx_len += 1  # this step wrote r.generated[-1]'s K/V
+            self._record_token(r, int(nxt[slot]), now)
+
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration (admit+prefill, then one decode step for
+        every occupied slot); returns True while work remains."""
+        admitted = self.scheduler.admit(now=self._clock())
+        if admitted:
+            self._prefill(admitted)
+        self._decode()
+        self._steps += 1
+        return self.scheduler.outstanding() > 0
+
+    def run(self, max_steps: int = 100000) -> Dict[int, Request]:
+        """Drive until every submitted request finished (or the step
+        budget trips — a scheduler bug, surfaced loudly)."""
+        for _ in range(max_steps):
+            if not self.step():
+                return self.finished
+        raise RuntimeError(
+            f"serving engine still has {self.scheduler.outstanding()} "
+            f"outstanding request(s) after {max_steps} steps")
+
+    def pop_finished(self) -> Dict[int, Request]:
+        """Drain completed requests.  A LONG-LIVED service must consume
+        results through here (or clear .finished itself) — the dict
+        otherwise retains every request ever completed."""
+        out = self.finished
+        self.finished = {}
+        return out
+
+    # ------------------------------------------------------------------
+    def programs(self) -> Dict[str, object]:
+        """The engine-built programs, for linting/inspection (the CI
+        smoke runs `python -m paddle_tpu lint` over these)."""
+        out = {"decode": self._decode_prog}
+        for b, (prog, _) in sorted(self._prefill_progs.items()):
+            out[f"prefill_{b}"] = prog
+        return out

@@ -1,0 +1,116 @@
+"""Host-side paged KV-cache bookkeeping: page pool + page tables.
+
+The device arrays (the K/V pools) are ordinary persistable scope state
+owned by the engine; this module owns the HOST view — which physical
+pages are free, and each decode slot's logical-block -> physical-page
+map.  Pages are the allocation quantum (vLLM/Ragged-Paged-Attention
+style): a request holds ceil((prompt + max_new) / page_size) pages from
+admission to eviction, so a mid-flight allocation can never fail and
+"no page leaked" reduces to alloc/free pairing (asserted by the
+double-free/foreign-free guards and tests/test_serving.py's property
+test).
+
+Page 0 is the reserved NULL PAGE: never allocated, the target of every
+masked write (prompt pad tails, inactive decode slots) and of every
+unallocated page-table entry, so garbage traffic can never touch a live
+request's pages.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def page_size_from_env(default: int = 16) -> int:
+    """PADDLE_TPU_PAGE_SIZE: tokens per KV page.  16 fills a whole
+    sublane tile in bf16 (and two in f32) — the smallest size the Pallas
+    kernel gate accepts; raise it to trade page-table length for
+    allocation granularity."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_PAGE_SIZE", str(default)))
+    except ValueError:
+        return default
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    return -(-int(tokens) // int(page_size))
+
+
+class PageAllocator:
+    """Free-list allocator over a fixed pool; page 0 reserved."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (page 0 is the null page), "
+                             f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        # LIFO free list: hot pages get reused first (their pool lines are
+        # the ones most recently touched on device)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._held = set()
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None if the pool can't cover them (all-or-nothing:
+        a partial grant would deadlock two half-admitted requests)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._held.update(pages)
+        return pages
+
+    def free(self, pages: List[int]):
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"free of page {p} not currently held (double free or "
+                    f"foreign page)")
+            self._held.discard(p)
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Page tables for a fixed set of decode slots + the allocator.
+
+    page_table[slot] maps logical block j to the physical page holding
+    positions [j*ps, (j+1)*ps); entries beyond a request's pages stay 0
+    (the null page) so they are always safe to gather/scatter through."""
+
+    def __init__(self, num_slots: int, max_pages_per_seq: int,
+                 num_pages: int, page_size: int):
+        import numpy as np
+
+        self.num_slots = int(num_slots)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.page_size = int(page_size)
+        self.allocator = PageAllocator(num_pages)
+        self.page_table = np.zeros((self.num_slots, self.max_pages_per_seq),
+                                   dtype=np.int32)
+        self._pt_i64 = None  # cached feed view, see page_table_i64()
+
+    def assign(self, slot: int, pages: List[int]):
+        if len(pages) > self.max_pages_per_seq:
+            raise ValueError(f"{len(pages)} pages > max_pages_per_seq="
+                             f"{self.max_pages_per_seq}")
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :len(pages)] = pages
+        self._pt_i64 = None
+
+    def release(self, slot: int):
+        self.page_table[slot, :] = 0
+        self._pt_i64 = None
+
+    def page_table_i64(self):
+        """The int64 feed view of the page table, cached between
+        mutations: steady-state decode (no admits/evictions for hundreds
+        of steps) must not pay a fresh host copy + upload per token."""
+        import numpy as np
+
+        if self._pt_i64 is None:
+            self._pt_i64 = self.page_table.astype(np.int64)
+        return self._pt_i64

@@ -1,0 +1,137 @@
+"""Request-level continuous batching: FIFO admission, slot eviction.
+
+One scheduler instance owns the decode slots of one ServingEngine.  Each
+engine iteration calls `admit()` (fill free slots from the waiting queue
+— the PREFILL phase) and later `finish()` per completed request (the
+EVICTION phase: slot and pages return to the free sets immediately, so
+the next iteration's admit() can reuse them).  This is the
+prefill/decode disaggregation loop of ROADMAP item #1: new requests join
+and finished ones leave between single decode steps, instead of the
+whole batch running lock-step to the longest request (the static-batch
+failure mode).
+
+Admission is STRICT FIFO with head-blocking: requests are admitted in
+arrival order, and if the head of the queue cannot be placed (no slot,
+or the pool cannot cover its worst-case pages) nothing behind it is
+considered.  That costs some utilization when a big request heads the
+queue, but it makes non-starvation a structural property — the admission
+order IS the arrival order — which the property test asserts rather
+than assumes.
+
+Pages are reserved worst-case at admission (ceil((prompt + max_new)/ps),
+kv_cache.pages_needed), so decode never allocates and can never OOM
+mid-flight; dynamic page growth with preemption is future work and would
+live entirely here.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from typing import Dict, List, Optional
+
+from .kv_cache import PagedKVCache, pages_needed
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens: int, rid: Optional[int] = None,
+                 arrival: float = 0.0):
+        self.rid = next(self._ids) if rid is None else rid
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.arrival = arrival
+        self.state = WAITING
+        self.generated: List[int] = []
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self.ctx_len = 0  # tokens currently materialized in the cache
+        # timing (engine clock): admission, first token, completion
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, cache: PagedKVCache, max_prefill_per_step: int = 4):
+        self.cache = cache
+        self.max_prefill_per_step = int(max_prefill_per_step)
+        self.waiting: deque = deque()
+        self.active: Dict[int, Request] = {}  # slot -> request
+        # pop() from the tail keeps low slot ids hot
+        self._free_slots = list(range(cache.num_slots - 1, -1, -1))
+        # FIFO witness (the property test asserts admission == arrival);
+        # bounded so a long-lived service doesn't grow it forever
+        self.admission_order: deque = deque(maxlen=4096)
+
+    # ------------------------------------------------------------------
+    def submit(self, req: Request):
+        """Queue a request — rejecting here anything that could NEVER be
+        admitted (worst-case pages beyond what the pool can ever hold):
+        under head-blocking FIFO an unadmittable head would stall the
+        queue forever, and a mid-admit rejection would strand the
+        requests admitted earlier in the same batch."""
+        if req.state != WAITING:
+            raise ValueError(f"request {req.rid} is {req.state}")
+        need = pages_needed(len(req.prompt) + req.max_new_tokens,
+                            self.cache.page_size)
+        cap = min(self.cache.max_pages_per_seq,
+                  self.cache.allocator.num_pages - 1)
+        if need > cap:
+            raise ValueError(
+                f"request {req.rid}: worst case {need} pages but the pool "
+                f"can ever grant {cap} (num_pages="
+                f"{self.cache.allocator.num_pages} incl. the null page, "
+                f"max_pages_per_seq={self.cache.max_pages_per_seq})")
+        self.waiting.append(req)
+
+    def outstanding(self) -> int:
+        return len(self.waiting) + len(self.active)
+
+    def admit(self, now: float = 0.0) -> List[Request]:
+        """Move queue-head requests into free slots (prefill phase).
+        Bounded by max_prefill_per_step so one iteration's prefill work
+        cannot stall the running requests' decode latency indefinitely."""
+        out: List[Request] = []
+        while (self.waiting and self._free_slots
+               and len(out) < self.max_prefill_per_step):
+            req = self.waiting[0]
+            # submit() proved need <= the pool's lifetime capacity, so a
+            # failed alloc here is transient pressure, never a stall
+            need = pages_needed(len(req.prompt) + req.max_new_tokens,
+                                self.cache.page_size)
+            pages = self.cache.allocator.alloc(need)
+            if pages is None:
+                break  # head-blocking FIFO: never skip past the head
+            self.waiting.popleft()
+            slot = self._free_slots.pop()
+            req.slot, req.pages = slot, pages
+            req.state = RUNNING
+            req.admit_t = now
+            self.cache.assign(slot, pages)
+            self.active[slot] = req
+            self.admission_order.append(req.rid)
+            out.append(req)
+        return out
+
+    def finish(self, req: Request, now: float = 0.0):
+        """Evict a completed request: pages and slot return immediately."""
+        if req.state != RUNNING:
+            raise ValueError(f"request {req.rid} is {req.state}")
+        req.state = FINISHED
+        req.finish_t = now
+        self.cache.release(req.slot)
+        self.cache.allocator.free(req.pages)
+        del self.active[req.slot]
+        self._free_slots.append(req.slot)
+        req.slot = None
+        req.pages = []

@@ -11,6 +11,18 @@ cd "$(dirname "$0")"
 python tools/repo_lint.py
 JAX_PLATFORMS=cpu python tools/lint_smoke.py
 
+# serving smoke (docs/serving.md): tiny-model continuous batching on CPU
+# with the verifier armed, then `paddle_tpu lint` over the engine-built
+# prefill/decode programs so the PR 6 verifier covers the serving tier
+serve_progs=$(mktemp -d)
+trap 'rm -rf "$serve_progs"' EXIT
+JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=1 python tools/serve_bench.py --smoke \
+    --save-programs "$serve_progs" > /dev/null
+for p in "$serve_progs"/*.json; do
+    JAX_PLATFORMS=cpu python -m paddle_tpu lint "$p" > /dev/null \
+        || { echo "serving program lint failed: $p"; exit 1; }
+done
+
 python -m pytest tests/ -q "$@"
 
 # two-process multi-host smoke (jax.distributed + global-mesh

@@ -42,3 +42,10 @@ def fresh_state():
 
     paddle_tpu.reset()
     yield
+
+
+def pytest_configure(config):
+    # the tier-1 command filters with -m 'not slow': anything excluded
+    # there must still run in the full run_tests.sh pass
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' pass")

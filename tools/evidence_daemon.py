@@ -183,6 +183,12 @@ CAPTURES = [
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "gpt_gen", "BENCH_BS": "1", "BENCH_ITERS": "4"},
      580),
+    # first on-chip serving row (ISSUE 7): continuous-batching tokens/s +
+    # p50/p99 latency under Poisson traffic, bs1 sweep riding along
+    ("serve_bench",
+     [sys.executable, "tools/serve_bench.py"],
+     {"SERVE_SLOTS": "64", "SERVE_REQUESTS": "96", "SERVE_SWEEP": "1,8"},
+     580),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},

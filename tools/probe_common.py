@@ -71,6 +71,8 @@ def _classify_metric(name):
         return "gpt"
     if name.startswith("gpt") and "_decode_" in name:
         return "gpt_gen"
+    if name.startswith("serve") and "_tok_per_s" in name:
+        return "serve"
     return None
 
 
@@ -84,6 +86,7 @@ _ANCHOR_CONFIGS = {
     "infer": (("_bs16_", "_bnfold"), ()),
     "gpt": (("_seq1024_",), ("_remat",)),
     "gpt_gen": (("_p64_g192_",), ()),
+    "serve": (("_bs64_",), ()),
 }
 
 

@@ -59,12 +59,18 @@ def main():
                 continue
             ok_rows.append((utc, name, r))
 
-    print("| capture | metric | value | unit | vs baseline | mfu |")
-    print("|---|---|---|---|---|---|")
+    print("| capture | metric | value | unit | vs baseline | mfu "
+          "| p50/p99 ms |")
+    print("|---|---|---|---|---|---|---|")
     for utc, name, r in ok_rows:
+        # serving rows (tools/serve_bench.py) carry request-latency
+        # percentiles beside the throughput headline
+        pct = r.get("percentiles") or {}
+        ptxt = (f"{pct.get('p50_ms', '')}/{pct.get('p99_ms', '')}"
+                if pct else "")
         print(f"| {name} | {r['metric']} | {r.get('value')} "
               f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
-              f"| {r.get('mfu', '')} |")
+              f"| {r.get('mfu', '')} | {ptxt} |")
     if failed:
         print("\nFailed/empty captures:")
         for name, err in failed:
