@@ -11,9 +11,12 @@ and TensorFlow's pre-execution graph checks play (PAPERS.md).
     report.raise_if_errors()
 
 Layers:
-  dataflow.py  — def-use chains, happens-before graph, live intervals
+  dataflow.py  — def-use chains, happens-before graph, live intervals,
+                 donation state classes
   verifier.py  — the PTV rule engine (stable IDs, severities, suppressions)
   contracts.py — verified-in/verified-out wrappers for the transpilers
+  cost.py      — FLOPs/roofline model + predicted step time per chip spec
+  memory.py    — static HBM-peak estimator (remat/donation/shard-aware)
 """
 
 from .dataflow import (  # noqa: F401
@@ -21,6 +24,7 @@ from .dataflow import (  # noqa: F401
     def_use,
     happens_before,
     hazards,
+    state_classes,
     sub_block_indices,
     var_intervals,
 )
@@ -32,3 +36,5 @@ from .verifier import (  # noqa: F401
     verify_program,
 )
 from . import contracts  # noqa: F401
+from . import cost  # noqa: F401
+from . import memory  # noqa: F401

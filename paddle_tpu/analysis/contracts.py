@@ -98,17 +98,55 @@ def liveness_diff(before: dict, program, batch_size: int = 64,
     return findings
 
 
+def planner_peak_bytes(program, batch_size: int = 64,
+                       block_id: int = 0) -> int:
+    """Projected peak (persistent + activation peak) in the
+    memory_optimize PLANNER's own model, under the program's CURRENT
+    remat marking.  The quantified contract is stated in this currency
+    deliberately: the pass promises to reduce the projection it plans
+    against; the independently-validated estimator
+    (analysis/memory.peak_estimate) models remat more conservatively
+    (per-op checkpoints re-derive their residuals as workspace) and
+    would mis-referee the planner's optimistic accounting."""
+    from ..memory_optimization_transpiler import projected_peak_bytes
+
+    return int(projected_peak_bytes(program, batch_size, block_id,
+                                    honor_remat=True)["total_bytes"])
+
+
+def quantified_peak_reduction(before_peak: int, program,
+                              batch_size: int = 64, block_id: int = 0,
+                              marked: int = 0) -> tuple:
+    """(after_peak, findings): PTV017 when the pass marked ops yet its
+    projected HBM peak did not drop — remat FLOPs paid for no memory
+    win.  Callable on its own so tests can drive the postcondition
+    against a corrupted marking."""
+    after_peak = planner_peak_bytes(program, batch_size, block_id)
+    findings: List[Finding] = []
+    if marked > 0 and after_peak >= before_peak:
+        findings.append(Finding(
+            "PTV017", f"marked {marked} grad op(s) for remat but the "
+            f"projected peak went {before_peak} -> {after_peak} bytes "
+            f"(reduction {before_peak - after_peak})", block=block_id))
+    return after_peak, findings
+
+
 def checked_memory_optimize(program, level: int = 0, batch_size: int = 64,
                             hbm_bytes: Optional[int] = None,
-                            block_id: int = 0) -> int:
+                            block_id: int = 0,
+                            report: Optional[dict] = None) -> int:
     """memory_optimize under contract; returns #ops marked (same as the
-    raw pass).  Raises VerificationError on bad input, bad output, or any
-    extended live range / peak regression."""
+    raw pass).  Raises VerificationError on bad input, bad output, any
+    extended live range / peak regression (PTV012), or a marking that
+    did not reduce the quantified static peak (PTV017).  Pass `report={}`
+    to receive {"peak_before", "peak_after", "reduction_bytes"} — the
+    proven peak reduction, not a claim."""
     from ..memory_optimization_transpiler import memory_optimize
 
     _verify(program, "memory_optimize:in", block_id=block_id,
             check_shapes=False)
     before = liveness_snapshot(program, batch_size, block_id)
+    peak_before = planner_peak_bytes(program, batch_size, block_id)
     with _inside():
         n = memory_optimize(program, level=level, batch_size=batch_size,
                             hbm_bytes=hbm_bytes, block_id=block_id)
@@ -117,6 +155,19 @@ def checked_memory_optimize(program, level: int = 0, batch_size: int = 64,
     bad = liveness_diff(before, program, batch_size, block_id)
     if bad:
         raise VerificationError("memory_optimize:liveness", bad)
+    # level>=1 is the blanket compile-at-all trade: its contract is
+    # PTV012 only (marking everything may legitimately leave the peak
+    # where it was on an activation-light program)
+    peak_after, findings = quantified_peak_reduction(
+        peak_before, program, batch_size, block_id,
+        marked=n if level < 1 else 0)
+    if report is not None:
+        report.update(peak_before=int(peak_before),
+                      peak_after=int(peak_after),
+                      reduction_bytes=int(peak_before - peak_after),
+                      marked=int(n))
+    if findings:
+        raise VerificationError("memory_optimize:peak", findings)
     return n
 
 

@@ -153,6 +153,54 @@ def var_intervals(block) -> Dict[str, Tuple[int, int]]:
     return {n: (a, b) for n, (a, b) in iv.items()}
 
 
+def state_classes(block, feed_names=(), skip_types=("feed", "fetch")
+                  ) -> Tuple[List[str], List[str], List[str]]:
+    """(external_reads, rw_state, written_state) for one block — the
+    executor's donation classification (framework/executor.py jits the
+    step with donate_argnums on rw_state), computable from descs alone.
+
+      external_reads — names read from the scope and never overwritten
+      rw_state       — read BEFORE written: pre-existing state updated in
+                       place; the executor donates these buffers, so the
+                       old value's storage is consumed by the step
+      written_state  — every name persisted back to the scope (rw_state
+                       plus persistable outputs that were never read)
+
+    Kept in one place so the donation-safety rules (verifier PTV015/016)
+    and the HBM estimator (analysis/memory.py) price exactly the buffers
+    the executor actually donates."""
+    produced = set(feed_names)
+    external_reads: List[str] = []
+    rw_state: List[str] = []
+    written_state: List[str] = []
+    seen_reads = set()
+    for op in block.ops:
+        if op.type in skip_types:
+            continue
+        for n in op.input_names():
+            if n and n not in produced and n not in seen_reads:
+                seen_reads.add(n)
+                external_reads.append(n)
+        for n in op.output_names():
+            if not n:
+                continue
+            if n in seen_reads and n not in rw_state:
+                rw_state.append(n)
+                written_state.append(n)
+            produced.add(n)
+    for op in block.ops:
+        if op.type in skip_types:
+            continue
+        for n in op.output_names():
+            if not n or n in written_state:
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                written_state.append(n)
+    external_reads = [n for n in external_reads if n not in rw_state]
+    return external_reads, rw_state, written_state
+
+
 def forward_closure(block, seeds, stop_types=()) -> set:
     """Names reachable FROM `seeds` through op dataflow (op order), skipping
     ops whose type is in `stop_types`.  Used by the missing-grad rule to ask

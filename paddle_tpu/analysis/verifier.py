@@ -85,6 +85,22 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "a transpiler broke its own output contract: optimizer ops "
          "survived the distribute split, fold count disagrees with the "
          "batch_norm census, or a plan-only pass mutated the program"),
+    Rule("PTV015", "donated-overwrite-race", WARNING,
+         "a read of donated (read-then-written) scope state races a BLIND "
+         "overwrite (a write whose op does not consume the old value): "
+         "under buffer donation the overwrite invalidates the storage the "
+         "read needs, so an unordered schedule is a use-after-free, not "
+         "just a value change"),
+    Rule("PTV016", "sharded-donated-state", WARNING,
+         "donated rw-state is sharded over mesh axes under the given "
+         "plan: host materialization of a stale handle after the step "
+         "(checkpoint gathers, np.asarray on the old array) is the native "
+         "jax-CPU crash family contained in tests/_native_isolation.py — "
+         "gather on device or go through distributed.checkpoint"),
+    Rule("PTV017", "remat-peak-not-reduced", ERROR,
+         "memory_optimize marked grad ops for rematerialization but the "
+         "projected HBM peak did not drop — remat FLOPs paid for no "
+         "memory win (quantified memory_optimize contract)"),
 ]}
 
 # ops the executor skips (framework/executor.py _NOOP_TYPES) plus desc-only
@@ -295,6 +311,109 @@ def _check_hazards(program):
                 rule, f"{verb} at op {i} ({b.ops[i].type}) and write at op "
                 f"{j} ({b.ops[j].type}) have no happens-before path",
                 block=b.idx, op=j, var=name)
+
+
+def _donated_by_block(program, feed_names):
+    """{block_idx: rw-state names} for top-level blocks — the buffers
+    the executor donates, computed ONCE and shared by PTV015/PTV016.
+    Feed context defaults to the declared data vars when the caller gave
+    none (matching what Executor.run would classify)."""
+    out = {}
+    for b in program.blocks:
+        if b.parent_idx >= 0:
+            continue
+        feeds = feed_names
+        if feeds is None:
+            feeds = [n for n, v in b.vars.items() if v.is_data]
+        _, rw_state, _ = dataflow.state_classes(b, feeds)
+        out[b.idx] = rw_state
+    return out
+
+
+def _check_donation_races(program, donated):
+    """PTV015: for every donated name, each read of the OLD (scope)
+    value — i.e. before the first in-block write — must happen-before
+    that write, UNLESS the writing op itself consumes the old value
+    (the sgd Param->ParamOut / beta-pow / K/V-pool self-update idiom,
+    where XLA's aliasing keeps the in-place update sound regardless of
+    schedule).  A blind overwrite with an unordered reader is flagged:
+    donation makes that schedule a use-after-free.  The happens-before
+    closure is only built when a blind-write candidate exists — clean
+    programs (every state write a self-update) never pay for it."""
+    for b in program.blocks:
+        rw = donated.get(b.idx)
+        if not rw:
+            continue
+        defs, uses = dataflow.def_use(b)
+        candidates = []
+        for name in rw:
+            dlist = defs.get(name)
+            if not dlist:
+                continue
+            first_def = dlist[0]
+            # old-value readers: only reads BEFORE the first write observe
+            # the scope (donated) buffer; reads between writes observe SSA
+            # values and belong to PTV008's WAR domain
+            readers = [k for k in uses.get(name, []) if k < first_def]
+            if not readers:
+                continue
+            # EVERY blind write races them, not just the first — a clean
+            # self-update first write must not shadow a later blind one
+            # (the donated allocation stays aliased through the chain)
+            blind = [j for j in dlist
+                     if name not in b.ops[j].input_names()]
+            if blind:
+                candidates.append((name, blind, readers))
+        if not candidates:
+            continue
+        anc = dataflow.happens_before(b)
+        for name, blind, readers in candidates:
+            done = False
+            for j in blind:
+                for k in readers:
+                    if not (anc[j] >> k) & 1:
+                        yield Finding(
+                            "PTV015",
+                            f"op {k} ({b.ops[k].type}) reads the donated "
+                            f"buffer and op {j} ({b.ops[j].type}) blindly "
+                            f"overwrites it with no happens-before path",
+                            block=b.idx, op=j, var=name)
+                        done = True
+                        break  # one finding per name
+                if done:
+                    break
+
+
+def _check_sharded_donation(program, donated, plan):
+    """PTV016: donated rw-state sharded over >=1 mesh axis under `plan`.
+    Sharded-ness is judged by NAMED AXES in the spec, not the byte
+    divisor: a bare PartitionSpec carries no mesh (divisor would be 1)
+    yet still declares the var sharded — the rule must not go silently
+    inert on that documented input.  A NamedSharding whose named axes
+    all have size 1 is effectively replicated and exempt."""
+    from .memory import shard_divisor, _spec_entries
+
+    if not plan:
+        return
+    for b in program.blocks:
+        if b.parent_idx >= 0:
+            continue
+        for name in donated.get(b.idx, ()):
+            sh = plan.get(name)
+            if sh is None:
+                continue
+            axes = tuple(_spec_entries(sh))
+            if not axes:
+                continue
+            if getattr(sh, "mesh", None) is not None \
+                    and shard_divisor(sh) <= 1:
+                continue  # size-1 axes: replicated in practice
+            yield Finding(
+                "PTV016",
+                f"donated state sharded over axes {axes} — host "
+                f"materialization of a stale handle after the step can "
+                f"abort natively",
+                block=b.idx, var=name)
 
 
 def _grad_name(name: str) -> str:
@@ -531,7 +650,8 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
                    block_id: int = 0, batch_size: int = 2,
                    rules: Optional[Iterable[str]] = None,
                    suppress: Iterable[str] = (),
-                   check_shapes: bool = True) -> Report:
+                   check_shapes: bool = True,
+                   plan: Optional[dict] = None) -> Report:
     """Run the rule engine over `program`; returns a `Report`.
 
     feed_names/fetch_names give the run context (PTV003/PTV004/PTV010 need
@@ -539,7 +659,9 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
     guessed).  `rules` restricts to a subset of RULE ids; `suppress`
     removes ids globally; per-op suppression rides the
     ``__verify_suppress__`` attr.  `check_shapes=False` skips the abstract
-    eval (PTV006) for desc-only speed."""
+    eval (PTV006) for desc-only speed.  `plan` ({var: NamedSharding /
+    PartitionSpec}, e.g. `ParallelExecutor.static_plan(program)`) arms the
+    sharded-donation rule (PTV016) for SPMD programs."""
     feed_names = list(feed_names) if feed_names is not None else None
     fetch_names = list(fetch_names) if fetch_names is not None else None
     enabled = set(rules) if rules is not None else set(RULES)
@@ -569,6 +691,13 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
         findings.extend(_check_dead_ops(program, block_id, fetch_names))
     if want("PTV011"):
         findings.extend(_check_unused_vars(program))
+    if want("PTV015") or (want("PTV016") and plan):
+        donated = _donated_by_block(program, feed_names)
+        if want("PTV015"):
+            findings.extend(_check_donation_races(program, donated))
+        if want("PTV016"):
+            findings.extend(_check_sharded_donation(program, donated,
+                                                    plan))
     if want("PTV006") and check_shapes \
             and not any(f.rule in ("PTV001", "PTV002") for f in findings):
         # abstract eval assumes a lowerable block; structural errors first

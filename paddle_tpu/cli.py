@@ -12,6 +12,10 @@ Usage: python -m paddle_tpu <subcommand> [args]
   validate DIR|FILE     — structural check via the native desc library
   lint DIR|FILE         — static dataflow verifier (analysis/verifier.py):
                           PTV rule findings report; exit 1 on errors
+  analyze DIR|FILE      — static cost & memory analyzer (analysis/cost.py,
+                          analysis/memory.py): FLOPs, HBM traffic and
+                          peak, arithmetic intensity, predicted step time
+                          for a chip spec; --json for one machine line
   show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
   master ...            — fault-tolerant task-dispatch service
@@ -182,6 +186,24 @@ def cmd_lint(args) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    from .analysis import cost as acost
+    from .analysis import memory as amem
+
+    program, feed, fetch = _load_program_any(args.model)
+    cost_rep = acost.program_cost(program, batch_size=args.batch_size,
+                                  chip=args.chip)
+    mem_rep = amem.peak_estimate(program, batch_size=args.batch_size,
+                                 infer_shapes=not args.no_shapes)
+    if args.json:
+        print(json.dumps({"model": args.model, "cost": cost_rep,
+                          "memory": mem_rep}))
+    else:
+        print(acost.render(cost_rep))
+        print(amem.render(mem_rep))
+    return 0
+
+
 def cmd_show_pb(args) -> int:
     from .utils import show_pb
 
@@ -264,6 +286,21 @@ def main(argv=None) -> int:
     p.add_argument("--no-shapes", action="store_true",
                    help="skip abstract shape/dtype eval (PTV006)")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("analyze")
+    p.add_argument("model", help="saved model dir, __model__ file, or "
+                                 "program.json")
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="value binding -1 feed dims in the cost/peak model")
+    p.add_argument("--chip", default=None,
+                   help="chip spec for the roofline prediction "
+                        f"(default $PADDLE_TPU_CHIP or v5e)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of the human tables")
+    p.add_argument("--no-shapes", action="store_true",
+                   help="skip the abstract-eval shape oracle (desc-only "
+                        "speed; -1 dims bind to --batch-size)")
+    p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser("merge_model")
     p.add_argument("model_dir")

@@ -195,6 +195,15 @@ class Executor:
         jit argument-tuple contract inside this file instead of tools
         reaching into _cache/_prepare_feeds (ADVICE-style: private layout
         changes must not silently break the roofline tooling)."""
+        return self._lowered(program, feed, fetch_list, scope,
+                             block_id).compile().as_text()
+
+    def _lowered(self, program, feed, fetch_list, scope, block_id):
+        """Shared analysis-path plumbing for optimized_hlo/memory_stats:
+        resolve the cached executable under run()'s exact staleness
+        contract (cache key + load-file signature; a recompile is stored
+        back so a later run() reuses the trace — ADVICE r4) and return
+        the jax Lowering of the step over the CURRENT scope state."""
         import jax
 
         from .core import default_main_program
@@ -210,21 +219,43 @@ class Executor:
         load_sig = self._load_file_sig(program)
         entry = self._cache.get(key)
         if entry is None or entry[0] != load_sig:
-            # same staleness contract as run(): a rewritten load file means
-            # the cached trace no longer matches what run() would execute
             compiled = self._compile(program, block_id, feed_vals,
                                      fetch_names)
-            # store under run()'s (load_sig, compiled) contract so a later
-            # run() — or a repeat optimized_hlo() before any run — reuses
-            # this trace instead of paying a full retrace (ADVICE r4)
             self._cache[key] = (load_sig, compiled)
         else:
             compiled = entry[1]
         state_w = {n: scope.find(n) for n in compiled.rw_state}
         state_r = {n: scope.find(n) for n in compiled.external_reads}
-        return compiled.fn.lower(
-            state_w, state_r, feed_vals, jax.random.PRNGKey(0)
-        ).compile().as_text()
+        return compiled.fn.lower(state_w, state_r, feed_vals,
+                                 jax.random.PRNGKey(0))
+
+    def memory_stats(self, program=None, feed=None, fetch_list=None,
+                     scope=None, block_id: int = 0) -> dict:
+        """XLA buffer-assignment byte counts of the step executable —
+        the MEASURED side of the static HBM-peak validation
+        (analysis/memory.py vs tools/hlo_analysis.py).
+
+        Returns argument/output/temp/alias sizes plus `peak_bytes` =
+        argument + temp: donated outputs alias the argument buffers
+        (counted once there), and non-donated outputs are the fetch
+        list, which the static estimator's activation set already
+        covers.  Deliberately NOT argument+temp+output-alias: an
+        executable deserialized from the persistent compile cache
+        reports alias_size 0 while output_size still counts the
+        donated state, so that formula double-counts every parameter
+        on cache hits and the "measured" number would depend on cache
+        temperature.  Same cache contract as optimized_hlo (shared via
+        _lowered)."""
+        ma = self._lowered(program, feed, fetch_list, scope,
+                           block_id).compile().memory_analysis()
+        stats = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+        stats["peak_bytes"] = stats["argument_bytes"] + stats["temp_bytes"]
+        return stats
 
     def _pin_host_array(self, scope, name, v):
         """Promote a host (numpy) scope value to a device buffer ONCE,
@@ -490,43 +521,12 @@ class Executor:
     def _analyze(self, block, feed_names):
         """Static pass over the desc: which names are read from the scope and
         which scope/persistable names the block writes (params updated by
-        optimizer ops, BN stats, metric states)."""
-        produced = set(feed_names)
-        external_reads: List[str] = []
-        rw_state: List[str] = []
-        written_state: List[str] = []
-        seen_reads = set()
-        for op in block.ops:
-            if op.type in _NOOP_TYPES:
-                continue
-            for n in op.input_names():
-                if n and n not in produced and n not in seen_reads:
-                    seen_reads.add(n)
-                    external_reads.append(n)
-            for n in op.output_names():
-                if not n:
-                    continue
-                # a write to a var that pre-exists outside this run's dataflow
-                # (parameter update, stat update) must persist back to scope
-                if n in seen_reads and n not in rw_state:
-                    rw_state.append(n)
-                    written_state.append(n)
-                produced.add(n)
-        # persistable outputs that were never read still persist (e.g. startup
-        # program initializers writing params fresh)
-        for op in block.ops:
-            if op.type in _NOOP_TYPES:
-                continue
-            for n in op.output_names():
-                if not n or n in written_state:
-                    continue
-                v = block._find_var_recursive(n)
-                if v is not None and v.persistable:
-                    written_state.append(n)
-        # reads satisfied by pre-existing state that is also rewritten live on
-        # the donated side only
-        external_reads = [n for n in external_reads if n not in rw_state]
-        return external_reads, rw_state, written_state
+        optimizer ops, BN stats, metric states).  The classification lives in
+        analysis/dataflow.state_classes so the donation-safety rules and the
+        HBM estimator price exactly the buffers this executor donates."""
+        from ..analysis.dataflow import state_classes
+
+        return state_classes(block, feed_names, skip_types=_NOOP_TYPES)
 
     def _compile(self, program, block_id, feed_vals, fetch_names) -> _Compiled:
         import jax

@@ -110,15 +110,22 @@ def analyze_liveness(block, batch_size: int = 64, skip_uses_of=(),
 
 
 def projected_peak_bytes(program: Program, batch_size: int = 64,
-                         block_id: int = 0) -> Dict[str, int]:
+                         block_id: int = 0,
+                         honor_remat: bool = False) -> Dict[str, int]:
     """Desc-level projection of peak HBM residency for one train step:
     persistent state (params + optimizer moments, counted once — donation
-    updates them in place) plus the peak live transient set."""
+    updates them in place) plus the peak live transient set.
+    `honor_remat=True` applies the program's CURRENT ``__remat__``
+    marking (the quantified-contract currency —
+    analysis/contracts.planner_peak_bytes delegates here so the PTV017
+    referee and the pass share one formula)."""
     block = program.blocks[block_id]
     persistent = sum(
         _var_bytes(v, batch_size) for v in block.vars.values()
         if v.persistable)
-    _, act_peak, peak_i = analyze_liveness(block, batch_size)
+    marked = ([op for op in block.ops if op.attrs.get("__remat__")]
+              if honor_remat else ())
+    _, act_peak, peak_i = analyze_liveness(block, batch_size, marked)
     return {
         "persistent_bytes": int(persistent),
         "activation_peak_bytes": int(act_peak),
@@ -176,7 +183,14 @@ def memory_optimize(program: Program, level: int = 0,
 
     Under PADDLE_TPU_VERIFY=1 the pass runs inside its verified-in/
     verified-out contract (analysis/contracts.py): program checked before
-    and after, and the marking must provably not extend any live range.
+    and after, the marking must provably not extend any live range
+    (PTV012), and a level-0 marking must provably REDUCE the projected
+    peak (PTV017) — `contracts.checked_memory_optimize(report={})`
+    returns the quantified before/after/reduction.  For an
+    independently-validated absolute estimate (donation-, shard- and
+    workspace-aware, held to ±15% of XLA's buffer assignment) see
+    `analysis.memory.peak_estimate`; this module's projection is the
+    planner's own optimistic currency.
     """
     from .analysis import contracts
 

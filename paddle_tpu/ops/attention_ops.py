@@ -452,3 +452,70 @@ def beam_search_generate(ctx, ins, attrs):
     carry, _ = jax.lax.scan(step, carry, jnp.arange(L))
     h, tokens, scores, finished, ids_hist, lengths = carry
     return {"Ids": [ids_hist], "Scores": [scores], "Lengths": [lengths]}
+
+
+# ---------------------------------------------------------------------------
+# analytic cost formulas (analysis/cost.py; mechanism in registry.py)
+
+from .registry import register_cost  # noqa: E402
+
+
+def _sdpa_cost(ins, outs, attrs):
+    """4*B*H*T*S*D: the QK^T and PV matmuls (2*B*H*T*S*D each); softmax
+    and masking ride inside the same fused kernel.  Bytes override: the
+    flash path never materializes the [T,S] score matrix, so HBM traffic
+    is the Q/K/V reads plus the output write only."""
+    q = ins.get("Q", [None])[0]
+    k = ins.get("K", [None])[0]
+    if q is None or k is None or len(q.shape) != 4:
+        return {}
+    b, h, t, d = q.shape
+    s = k.shape[2]
+    flops = 4 * b * h * t * s * d
+    if bool(attrs.get("causal", False)):
+        flops //= 2  # masked half of the score matrix is never computed
+    return {"flops": flops}
+
+
+register_cost("scaled_dot_product_attention", _sdpa_cost)
+
+
+def _paged_decode_cost(ins, outs, attrs):
+    """One continuous-batching decode step: per-layer QKV/out projections
+    (8*N*D^2) + MLP (16*N*D^2) + paged attention over the page-table
+    worst case (4*N*H*dh*max_ctx) + the head logits matmul."""
+    emb = ins.get("Emb", [None])[0]
+    kpool = ins.get("KPool", [None])[0]
+    pt = ins.get("PageTable", [None])[0]
+    if emb is None or kpool is None or len(kpool.shape) != 5:
+        return {}
+    vocab, d = emb.shape
+    n_layers, _, n_heads, page, dh = kpool.shape
+    n = pt.shape[0] if pt is not None and len(pt.shape) == 2 else 1
+    max_ctx = (pt.shape[1] * page if pt is not None
+               and len(pt.shape) == 2 else page)
+    per_layer = 24 * n * d * d + 4 * n * n_heads * dh * max_ctx
+    return {"flops": n_layers * per_layer + 2 * n * d * vocab}
+
+
+register_cost("paged_decode_step", _paged_decode_cost)
+
+
+def _paged_prefill_cost(ins, outs, attrs):
+    """Bucket-padded prompt forward: tower matmuls (24*N*T*D^2 per layer)
+    + causal attention (2*N*H*T^2*dh per layer) + head logits."""
+    tokens = ins.get("Tokens", [None])[0]  # [N, P, 1] bucket-padded
+    emb = ins.get("Emb", [None])[0]
+    kpool = ins.get("KPool", [None])[0]
+    if tokens is None or emb is None or kpool is None \
+            or len(kpool.shape) != 5:
+        return {}
+    n = tokens.shape[0] if len(tokens.shape) >= 1 else 1
+    t = tokens.shape[1] if len(tokens.shape) >= 2 else 1
+    vocab, d = emb.shape
+    n_layers, _, n_heads, _, dh = kpool.shape
+    per_layer = 24 * n * t * d * d + 2 * n * n_heads * t * t * dh
+    return {"flops": n_layers * per_layer + 2 * n * d * vocab}
+
+
+register_cost("paged_prefill", _paged_prefill_cost)

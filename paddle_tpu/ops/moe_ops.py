@@ -148,3 +148,34 @@ def _moe_sharded(ctx, x, gate_w, wi, wo, mesh, token_axes, factor, act):
         return _combine(back, expert, src_slot, keep, gatew, xl)
 
     return run(x, gate_w, wi, wo)
+
+
+# ---------------------------------------------------------------------------
+# analytic cost formula (analysis/cost.py; mechanism in registry.py)
+
+from .registry import register_cost  # noqa: E402
+
+
+def _moe_cost(ins, outs, attrs):
+    """Gate matmul (2*T*D*E) + the two expert matmuls over every routed
+    token (4*T*D*H at capacity).  Bytes override adds the all_to_all
+    dispatch/return buffers (2 x token bytes each way) — the collective
+    traffic term the per-mode ICI ledgers (tools/hlo_analysis.py
+    collectives) measure for the ep programs."""
+    x = ins.get("X", [None])[0]
+    gate = ins.get("Gate", [None])[0]
+    wi = ins.get("WI", [None])[0]
+    if x is None or gate is None or wi is None or len(x.shape) != 2:
+        return {}
+    t, d = x.shape
+    e = gate.shape[1]
+    h = wi.shape[2] if len(wi.shape) == 3 else d
+    factor = float(attrs.get("capacity_factor", 1.0))
+    routed = int(t * max(factor, 1.0))
+    flops = 2 * t * d * e + 4 * routed * d * h
+    item = 2 if str(x.dtype) == "bfloat16" else 4
+    collective = 4 * t * d * item  # dispatch + return, both all_to_all
+    return {"flops": flops, "collective_bytes": collective}
+
+
+register_cost("moe", _moe_cost)

@@ -41,6 +41,11 @@ class OpInfo:
     non_diff_inputs: tuple = ()
     # output slots never given cotangents (e.g. saved state, masks, indices)
     non_diff_outputs: tuple = ()
+    # analytic cost model: fn(ins, outs, attrs) -> {"flops": int, "bytes": int}
+    # (either key optional) where ins/outs map slot -> [ShapeDtype|None].
+    # None → the analyzer's shape-driven defaults (analysis/cost.py): one
+    # flop per output element, bytes = inputs read + outputs written.
+    cost: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, OpInfo] = {}
@@ -57,6 +62,48 @@ def register_op(type: str, emit: Callable = None, **kw):
 
     if emit is not None:
         return _do(emit)
+    return _do
+
+
+class ShapeDtype:
+    """Static (shape, dtype) of one op operand, as the cost model sees it:
+    batch dims already bound, dtype a canonical string.  The cost-fn
+    analog of the ShapeDtypeStruct the verifier's abstract eval uses."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype="float32"):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def __repr__(self):
+        return f"ShapeDtype({self.shape}, {self.dtype})"
+
+
+def register_cost(type: str, fn: Callable = None):
+    """Attach an analytic cost formula to an already-registered op.
+    Usable as decorator or direct call; the formula lives beside the
+    emitter in the op's module (matmul/conv/attention/collectives), the
+    mechanism here.  fn(ins, outs, attrs) -> {"flops": int, "bytes": int}
+    with either key optional — missing keys fall back to the analyzer's
+    shape-driven defaults."""
+
+    def _do(f):
+        info = get_op_info(type)
+        if info.cost is not None:
+            raise ValueError(f"op {type!r} already has a cost formula")
+        info.cost = f
+        return f
+
+    if fn is not None:
+        return _do(fn)
     return _do
 
 
@@ -262,3 +309,30 @@ def _generic_grad_emit(ctx, ins, attrs):
 
 
 register_op("generic_grad", _generic_grad_emit, grad=None)
+
+
+def _generic_grad_cost(ins, outs, attrs):
+    """Backward ≈ 2x the forward's FLOPs (the dL/dX and dL/dW products of
+    every matmul/conv); a remat-marked grad op re-runs its forward first,
+    so __remat__ adds one more forward (the FLOPs-for-HBM trade the
+    memory_optimize pass prices)."""
+    info = _REGISTRY.get(attrs.get("__fwd_type__", ""))
+    fwd_ins = {s: ins.get(s, [])
+               for s in attrs.get("__fwd_input_slots__", ())}
+    fwd_outs = {s: ins.get(s, [])
+                for s in attrs.get("__fwd_output_slots__", ())}
+    fwd_flops = None
+    if info is not None and info.cost is not None:
+        try:
+            fwd_flops = info.cost(fwd_ins, fwd_outs,
+                                  attrs.get("__fwd_attrs__", {})).get("flops")
+        except Exception:
+            fwd_flops = None
+    if fwd_flops is None:
+        fwd_flops = sum(v.size for vs in fwd_outs.values()
+                        for v in vs if v is not None)
+    mult = 3 if attrs.get("__remat__") else 2
+    return {"flops": mult * int(fwd_flops)}
+
+
+register_cost("generic_grad", _generic_grad_cost)

@@ -128,10 +128,47 @@ class ParallelExecutor(Executor):
 
     def _state_shape(self, name):
         scope = self._active_scope
-        if scope is None:
-            return None
-        v = scope.find(name)
-        return tuple(v.shape) if v is not None else None
+        if scope is not None:
+            v = scope.find(name)
+            if v is not None:
+                return tuple(v.shape)
+        # desc fallback (static_plan runs before any scope state exists):
+        # -1 batch markers never appear on persistable state, so the
+        # declared shape is the real one
+        blk = getattr(self, "_desc_block", None)
+        if blk is not None:
+            dv = blk._find_var_recursive(name)
+            if dv is not None and dv.shape is not None:
+                return tuple(dv.shape)
+        return None
+
+    def static_plan(self, program, block_id: int = 0):
+        """EFFECTIVE per-variable shardings — the transpiler plan plus
+        the ZeRO-1/FSDP accumulator+parameter resharding — from descs
+        alone: no scope, no compilation, nothing runs.  This is the
+        `plan=` input to `analysis.verify_program` (sharded-donation
+        rule PTV016) and `analysis.memory.peak_estimate(per-shard)`."""
+        block = program.blocks[block_id]
+        plan = self._plan_for(program)
+        self._desc_block = block
+        try:
+            names = set()
+            for op in block.ops:
+                names.update(n for n in op.input_names() if n)
+                names.update(n for n in op.output_names() if n)
+            out = {}
+            for n in sorted(names):
+                v = block._find_var_recursive(n)
+                if v is None or not (v.persistable or v.is_data):
+                    # only the vars the executor actually CONSTRAINS:
+                    # transient shardings are GSPMD propagation, and a
+                    # replicated placeholder here would override the
+                    # estimator's batch-led heuristic with a lie
+                    continue
+                out[n] = self._shard_of(plan, n)
+            return out
+        finally:
+            self._desc_block = None
 
     # ------------------------------------------------------------------
     def _prepare_feeds(self, block, feed):

@@ -272,3 +272,38 @@ class ServingEngine:
         for b, (prog, _) in sorted(self._prefill_progs.items()):
             out[f"prefill_{b}"] = prog
         return out
+
+    def hbm_report(self) -> dict:
+        """Static HBM accounting of the serving engine (analysis/memory):
+        the resident K/V pools plus the peak of every engine-built
+        program at its compiled batch shape.  `total_peak_bytes` is the
+        worst program peak ON TOP of the pools — the number to compare
+        against a chip's HBM before sizing num_pages/max_batch_size."""
+        from ..analysis import memory as amem
+        from ..framework.core import np_dtype
+
+        dh = self.lm.dim // self.lm.n_heads
+        pool_shape = (self.lm.n_layers, self.num_pages, self.lm.n_heads,
+                      self.page_size, dh)
+        n = 1
+        for s in pool_shape:
+            n *= s
+        item = np.dtype(np_dtype(self.lm.dtype)).itemsize
+        kv_pool_bytes = 2 * n * item  # K and V
+        programs = {}
+        worst = 0
+        for name, prog in self.programs().items():
+            est = amem.peak_estimate(prog, batch_size=self.num_slots,
+                                     infer_shapes=False)
+            # pools are persistable vars of every program — already in
+            # kv_pool_bytes, so report the non-pool share per program
+            share = max(est["total_peak_bytes"] - kv_pool_bytes, 0)
+            programs[name] = share
+            worst = max(worst, share)
+        return {
+            "kv_pool_bytes": int(kv_pool_bytes),
+            "num_pages": int(self.num_pages),
+            "page_size": int(self.page_size),
+            "program_peak_bytes": programs,
+            "total_peak_bytes": int(kv_pool_bytes + worst),
+        }

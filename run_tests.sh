@@ -6,8 +6,10 @@ cd "$(dirname "$0")"
 
 ./build_native.sh
 
-# fast lint tier: repo hygiene + the program verifier end-to-end over two
-# saved book models (docs/analysis.md) — fails in seconds, before pytest
+# fast lint tier: repo hygiene + the program verifier AND the static
+# cost/memory analyzer (`paddle_tpu lint` + `paddle_tpu analyze`)
+# end-to-end over two saved book models (docs/analysis.md) — fails in
+# seconds, before pytest
 python tools/repo_lint.py
 JAX_PLATFORMS=cpu python tools/lint_smoke.py
 

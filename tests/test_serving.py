@@ -334,3 +334,34 @@ def test_serving_smoke_cli(tmp_path):
                for m in art["extra_metrics"])
     saved = list(progs.glob("*.json"))
     assert any(p.name == "decode.json" for p in saved)
+
+
+def test_engine_hbm_report():
+    """Static HBM accounting of the serving tier (analysis/memory):
+    pool bytes are exact arithmetic, program peaks ride the estimator,
+    and the total is pools + the worst program on top of them."""
+    lm, exe, logits = _build_lm()
+    eng = ServingEngine(lm, max_batch_size=2, eos_id=-1)
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.run()
+    rep = eng.hbm_report()
+    dh = lm.dim // lm.n_heads
+    expect_pool = 2 * (lm.n_layers * eng.num_pages * lm.n_heads
+                       * eng.page_size * dh) * 4  # float32
+    assert rep["kv_pool_bytes"] == expect_pool
+    assert set(rep["program_peak_bytes"]) >= {"decode"}
+    assert any(k.startswith("prefill_") for k in rep["program_peak_bytes"])
+    assert rep["total_peak_bytes"] == (
+        rep["kv_pool_bytes"] + max(rep["program_peak_bytes"].values()))
+
+    # the paged-op cost formulas fire on the engine's real programs
+    # (regression: a wrong slot name silently falls back to the
+    # ~zero-FLOP default without tripping unmodeled_ops)
+    from paddle_tpu.analysis import cost as acost
+
+    for name, prog in eng.programs().items():
+        blk = prog.global_block()
+        for op in blk.ops:
+            if op.type in ("paged_prefill", "paged_decode_step"):
+                c = acost.op_cost(blk, op, batch_size=eng.num_slots)
+                assert c["flops"] > 10_000, (name, op.type, c)

@@ -225,6 +225,13 @@ CAPTURES = [
     ("hlo_toplevel",
      [sys.executable, "tools/hlo_analysis.py", "bytes", "--bs", "128",
       "--tpu"], {}, 900),
+    # roofline decomposition (ISSUE 8): the static cost-model prediction
+    # (analysis/cost.py FLOPs/bytes/step-time) against the measured
+    # on-chip step time and MFU for the ResNet-50 headline shape —
+    # measured/predicted IS the tuner headroom number ROADMAP #3 wants
+    ("roofline_decomposition",
+     [sys.executable, "tools/hlo_analysis.py", "roofline", "--bs", "128",
+      "--tpu"], {}, 900),
     ("unet",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "unet", "BENCH_ITERS": "10"}, 580),

@@ -20,10 +20,19 @@ Two jobs, one methodology (parse XLA's post-optimization HLO dump):
 Usage:
   python tools/hlo_analysis.py bytes [--fuse-bn] [--no-remat] [--bs N]
   python tools/hlo_analysis.py collectives [--mode dp|sp_ring|sp_ulysses|ep]
-  python tools/hlo_analysis.py all   # everything, JSON per line
+  python tools/hlo_analysis.py peak      # static-vs-measured HBM peak on
+                                         # the 3 validation programs
+  python tools/hlo_analysis.py roofline [--tpu] [--bs N]
+                                         # ResNet-50: static cost-model
+                                         # prediction vs measured step
+                                         # time/MFU (evidence capture)
+  python tools/hlo_analysis.py all   # bytes+collectives, JSON per line
 
 The workload runs in a re-exec'd child with XLA_FLAGS=--xla_dump_to so
 the flags are set before jax imports; the parent parses the dump.
+`peak` and `roofline` also anchor the static analyzer's validation:
+`measured_peak_bytes` is the measured side tests/test_analysis.py holds
+`analysis.memory.peak_estimate` within ±15% of.
 """
 
 import argparse
@@ -161,7 +170,7 @@ def run_child(mode: str, dump_dir: str, args) -> None:
                        + f" --xla_dump_to={dump_dir}").strip()
     env["PDTPU_HLO_TEXT_DIR"] = dump_dir  # as_text() fallback target for
     # remote-compile backends that never write local dump files
-    if mode != "bytes":
+    if mode not in ("bytes", "roofline"):
         # multi-chip modes always use the virtual CPU mesh
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
@@ -220,6 +229,165 @@ def run_child(mode: str, dump_dir: str, args) -> None:
     if rc != 0:
         raise RuntimeError(f"child {mode} failed rc={rc}:\n"
                            f"{_tail(err_path)}")
+
+
+def measured_peak_bytes(exe, program, feed, fetch_list, block_id=0) -> dict:
+    """Measured side of the static-HBM validation: XLA's buffer
+    assignment via Executor.memory_stats (argument + temp arena; see
+    that docstring for why outputs are excluded).  Lives here so the
+    cross-validation methodology stays beside the other measured-bytes
+    ledgers this tool owns."""
+    return exe.memory_stats(program, feed=feed, fetch_list=fetch_list,
+                            block_id=block_id)
+
+
+def validation_programs():
+    """(name, build_fn, feed_fn, batch_size) for the 3 validation
+    programs the ±15% contract runs on: fit-a-line, recognize-digits,
+    and a small LM.  build_fn returns the fetch var after constructing
+    the train program in the default program; feed_fn(bs) returns the
+    feed dict."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    def fit_a_line():
+        x = fluid.layers.data(name="x", shape=[13])
+        y = fluid.layers.data(name="y", shape=[1])
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+        return cost
+
+    def fit_a_line_feed(bs):
+        r = np.random.RandomState(0)
+        return {"x": r.rand(bs, 13).astype("float32"),
+                "y": r.rand(bs, 1).astype("float32")}
+
+    def digits():
+        img = fluid.layers.data(name="img", shape=[1, 28, 28])
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                bias_attr=False)
+        b = fluid.layers.batch_norm(c, act="relu")
+        p = fluid.layers.pool2d(b, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(p, [-1, 8 * 12 * 12])
+        pred = fluid.layers.fc(flat, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return loss
+
+    def digits_feed(bs):
+        r = np.random.RandomState(0)
+        return {"img": r.rand(bs, 1, 28, 28).astype("float32"),
+                "label": r.randint(0, 10, (bs, 1)).astype("int64")}
+
+    def small_lm():
+        from paddle_tpu.models.transformer import build_lm_train_program
+
+        return build_lm_train_program(seq_len=64, vocab_size=512, dim=64,
+                                      n_layers=2, n_heads=2,
+                                      dtype="float32")
+
+    def small_lm_feed(bs):
+        r = np.random.RandomState(0)
+        return {"tokens": r.randint(0, 512, (bs, 64, 1)).astype("int64"),
+                "targets": r.randint(0, 512, (bs, 64, 1)).astype("int64")}
+
+    return [("fit_a_line", fit_a_line, fit_a_line_feed, 64),
+            ("recognize_digits", digits, digits_feed, 64),
+            ("small_lm", small_lm, small_lm_feed, 8)]
+
+
+def run_peak(args) -> None:
+    """In-process static-vs-measured HBM peak over the validation
+    programs, one JSON line each (the CI test asserts the same numbers
+    through the library API)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import memory as amem
+
+    for name, build, feed_fn, bs in validation_programs():
+        fluid.reset()
+        fetch = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        program = fluid.default_main_program()
+        measured = measured_peak_bytes(exe, program, feed_fn(bs), [fetch])
+        static = amem.peak_estimate(program, batch_size=bs)
+        print(json.dumps({
+            "analysis": "peak", "program": name, "batch_size": bs,
+            "static_peak_bytes": static["total_peak_bytes"],
+            "measured_peak_bytes": measured["peak_bytes"],
+            "ratio": round(static["total_peak_bytes"]
+                           / max(measured["peak_bytes"], 1), 4),
+        }), flush=True)
+
+
+def child_roofline(args) -> None:
+    """Static roofline prediction vs measured step time for the
+    ResNet-50 train step — the roofline-decomposition evidence row
+    (static prediction trustworthy ⇔ measured/predicted gap is the
+    tuner's headroom, ROADMAP #3)."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import cost as acost
+    from paddle_tpu.analysis import memory as amem
+    from paddle_tpu.models import resnet
+
+    hw = args.image
+    avg_cost, _ = resnet.build_train_program(
+        batch_size=args.bs, depth=50, dtype="bfloat16", layout="NHWC",
+        image_shape=(3, hw, hw), remat=not args.no_remat,
+        fuse_bn=args.fuse_bn)
+    program = fluid.default_main_program()
+    chip = acost.detect_chip()
+    static = acost.program_cost(program, batch_size=args.bs, chip=chip)
+    peak = amem.peak_estimate(program, batch_size=args.bs,
+                              infer_shapes=False)
+
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(args.bs, hw, hw, 3).astype("float32"),
+            "label": rng.randint(0, 1000, (args.bs, 1)).astype("int64")}
+    exe.run(feed=feed, fetch_list=[avg_cost])  # compile + warm
+    iters = 5
+    t0 = time.monotonic()
+    for _ in range(iters):
+        (out,) = exe.run(feed=feed, fetch_list=[avg_cost],
+                         return_numpy=False)
+    np.asarray(out)  # block on the last step
+    measured_s = (time.monotonic() - t0) / iters
+    spec = acost.chip_spec(chip)
+    measured_mfu = (static["total_flops"]
+                    / (measured_s * spec["flops_bf16"]))
+    print(json.dumps({
+        "analysis": "roofline", "chip": chip, "bs": args.bs,
+        "image": hw,
+        "static": {
+            "total_flops": static["total_flops"],
+            "hbm_bytes": static["hbm_bytes"],
+            "arithmetic_intensity": round(
+                static["arithmetic_intensity"], 2),
+            "predicted_step_ms": round(
+                static["predicted_step_time_s"] * 1e3, 3),
+            "predicted_bound": static["predicted_bound"],
+            "mfu_ceiling": round(static["mfu_ceiling"], 4),
+            "hbm_peak_bytes": peak["total_peak_bytes"],
+        },
+        "measured": {
+            "step_ms": round(measured_s * 1e3, 3),
+            "mfu": round(measured_mfu, 4),
+            "efficiency_vs_roofline": round(
+                static["predicted_step_time_s"] / measured_s, 4),
+        },
+    }), flush=True)
+    print("CHILD_OK")
 
 
 # --------------------------------------------------------------- workloads
@@ -366,10 +534,22 @@ def analyze(mode: str, args) -> dict:
     return rec
 
 
+def analyze_roofline(args) -> None:
+    """Driver half of the roofline capture: run the child (accelerator-
+    honoring, like bytes mode), pass its JSON line through."""
+    with tempfile.TemporaryDirectory(prefix="hlo_roofline_") as dump:
+        run_child("roofline", dump, args)
+        with open(os.path.join(dump, "child_stdout.txt")) as f:
+            for line in f:
+                if line.startswith("{"):
+                    print(line.rstrip(), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
-                    choices=["bytes", "collectives", "all"])
+                    choices=["bytes", "collectives", "peak", "roofline",
+                             "all"])
     ap.add_argument("--child", default=None)
     ap.add_argument("--mode", dest="submode", default=None)
     ap.add_argument("--bs", type=int, default=32)
@@ -387,10 +567,18 @@ def main():
     if args.child:
         if args.child == "bytes":
             child_bytes(args)
+        elif args.child == "roofline":
+            child_roofline(args)
         else:
             child_collectives(args.submode)
         return
 
+    if args.what == "peak":
+        run_peak(args)
+        return
+    if args.what == "roofline":
+        analyze_roofline(args)
+        return
     if args.what in ("bytes", "all"):
         for fuse in ((False, True) if args.what == "all"
                      else (args.fuse_bn,)):

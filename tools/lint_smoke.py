@@ -1,10 +1,13 @@
-"""Fast CI lint tier: build + save two book models, lint the saved dirs.
+"""Fast CI lint tier: build + save two book models, lint AND analyze
+the saved dirs.
 
 Exercises the full `paddle_tpu lint` path end-to-end (save_inference_model
 -> proto_io/program.json load -> verifier report) on fit-a-line and
-recognize-digits, the two canonical book programs.  Exit 0 iff both lint
-clean.  Runs on CPU in a few seconds; wired into run_tests.sh before the
-pytest tiers so a verifier/CLI regression fails fast.
+recognize-digits, the two canonical book programs, then runs
+`paddle_tpu analyze` (static cost & memory analyzer) over the same dirs
+so a cost-model/estimator regression also fails in seconds.  Exit 0 iff
+both models lint clean and analyze successfully.  Runs on CPU; wired
+into run_tests.sh before the pytest tiers.
 """
 
 from __future__ import annotations
@@ -67,8 +70,14 @@ def main() -> int:
                 print(f"lint_smoke: {name} FAILED (rc={r})",
                       file=sys.stderr)
             rc = rc or r
+            print(f"== paddle_tpu analyze {name}")
+            r = cli.main(["analyze", d])
+            if r:
+                print(f"lint_smoke: analyze {name} FAILED (rc={r})",
+                      file=sys.stderr)
+            rc = rc or r
     if not rc:
-        print("lint_smoke: OK (2 models)")
+        print("lint_smoke: OK (2 models, lint + analyze)")
     return rc
 
 

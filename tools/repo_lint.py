@@ -1,12 +1,17 @@
 """Repository hygiene lint (the fast CI tier in run_tests.sh).
 
-Two classes of rot this repo has actually accumulated:
+Three classes of rot this repo has actually accumulated:
 
   1. orphaned bytecode — a ``__pycache__/*.pyc`` whose source module was
      deleted (paddle_tpu/observability/ shipped exactly this: sources
      removed, compiled ghosts left importable-looking);
   2. packages missing ``__init__.py`` — a directory of .py modules under
-     the package tree that Python will not treat as a package.
+     the package tree that Python will not treat as a package;
+  3. direct ``TPUCompilerParams``/``CompilerParams`` construction —
+     jax renamed the pltpu class across releases (7 seed pallas tests
+     failed on it); every kernel must go through
+     ``ops/pallas_kernels/_common.compiler_params()``, which resolves
+     the name at runtime.  Only _common.py may touch the class.
 
 Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
 any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
@@ -16,12 +21,42 @@ test trees are intentionally not packages).
 from __future__ import annotations
 
 import os
+import re
 import sys
 
 # directory names whose contents are never package code
 _SKIP_DIRS = {".git", "__pycache__", "node_modules", ".venv"}
 # top-level trees exempt from the missing-__init__ rule
 _NO_INIT_OK = {"tests", "docs"}
+
+# the rename-shim regression guard: constructing either class name
+# directly bakes one jax release's spelling into a kernel.  The pattern
+# is assembled so this file does not flag itself.
+_COMPILER_PARAMS_RE = re.compile(
+    r"\b(?:TPU)?Compiler" + r"Params\s*\(")
+_COMPILER_PARAMS_OK = os.path.join(
+    "paddle_tpu", "ops", "pallas_kernels", "_common.py")
+
+
+def _check_compiler_params(root, dirpath, filenames, findings):
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel == _COMPILER_PARAMS_OK:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _COMPILER_PARAMS_RE.search(line):
+                        findings.append(
+                            f"direct CompilerParams construction: "
+                            f"{rel}:{i} (use ops/pallas_kernels/"
+                            f"_common.compiler_params() — the class "
+                            f"name changes across jax releases)")
+        except OSError:
+            pass
 
 
 def _source_for(pyc_name: str) -> str:
@@ -57,6 +92,7 @@ def lint(root: str):
                     f"(only __pycache__, no sources)")
             dirnames[:] = []
             continue
+        _check_compiler_params(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)
