@@ -17,6 +17,8 @@ Layers:
   contracts.py — verified-in/verified-out wrappers for the transpilers
   cost.py      — FLOPs/roofline model + predicted step time per chip spec
   memory.py    — static HBM-peak estimator (remat/donation/shard-aware)
+  sharding.py  — logical-axis rules, sharding propagation, reshard/
+                 conflict detection (PTV018-021), comm-aware roofline
 """
 
 from .dataflow import (  # noqa: F401
@@ -38,3 +40,4 @@ from .verifier import (  # noqa: F401
 from . import contracts  # noqa: F401
 from . import cost  # noqa: F401
 from . import memory  # noqa: F401
+from . import sharding  # noqa: F401

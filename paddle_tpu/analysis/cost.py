@@ -32,16 +32,25 @@ from ..ops.registry import ShapeDtype, has_op, get_op_info
 from . import memory as _mem
 
 # Public per-chip peak numbers (dense bf16 matmul TFLOP/s, HBM GB/s and
-# GiB per chip).  fp32 runs the MXU at half rate; fp64 has no MXU path.
+# GiB per chip, ICI per-link one-way GB/s, DCN per-chip GB/s).  fp32
+# runs the MXU at half rate; fp64 has no MXU path.  ici_gbps prices the
+# slowest hop of a ring/torus collective; dcn_gbps prices collectives
+# over a `dcn*`-named mesh axis (multi-slice) — the ~10x cliff PTV021
+# polices.
 CHIP_SPECS: Dict[str, dict] = {
-    "v4": {"flops_bf16": 275e12, "hbm_gbps": 1228.0, "hbm_gib": 32},
-    "v5e": {"flops_bf16": 197e12, "hbm_gbps": 819.0, "hbm_gib": 16},
-    "v5p": {"flops_bf16": 459e12, "hbm_gbps": 2765.0, "hbm_gib": 95},
-    "v6e": {"flops_bf16": 918e12, "hbm_gbps": 1640.0, "hbm_gib": 32},
+    "v4": {"flops_bf16": 275e12, "hbm_gbps": 1228.0, "hbm_gib": 32,
+           "ici_gbps": 45.0, "dcn_gbps": 6.25},
+    "v5e": {"flops_bf16": 197e12, "hbm_gbps": 819.0, "hbm_gib": 16,
+            "ici_gbps": 45.0, "dcn_gbps": 6.25},
+    "v5p": {"flops_bf16": 459e12, "hbm_gbps": 2765.0, "hbm_gib": 95,
+            "ici_gbps": 90.0, "dcn_gbps": 6.25},
+    "v6e": {"flops_bf16": 918e12, "hbm_gbps": 1640.0, "hbm_gib": 32,
+            "ici_gbps": 90.0, "dcn_gbps": 6.25},
     # honest placeholder for CPU runs of the same programs: roughly one
     # AVX2 core-complex; predictions on it are for plumbing tests, not
     # evidence rows
-    "cpu-host": {"flops_bf16": 0.2e12, "hbm_gbps": 40.0, "hbm_gib": 16},
+    "cpu-host": {"flops_bf16": 0.2e12, "hbm_gbps": 40.0, "hbm_gib": 16,
+                 "ici_gbps": 10.0, "dcn_gbps": 1.0},
 }
 
 _DTYPE_RATE = {"bfloat16": 1.0, "float16": 1.0,
@@ -219,6 +228,39 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
                                key=lambda kv: -kv[1]["flops"])),
     }
     return report
+
+
+def roofline_with_comm(report: dict, comm: dict,
+                       devices: int = 1) -> dict:
+    """Fold a communication report (`analysis.sharding.comm_report`)
+    into a `program_cost` roofline: predicted step time becomes
+    max(compute, HBM, comm) and the bound may now be "comm".  Returns a
+    NEW dict (the pure-compute report stays valid for single-chip
+    consumers).
+
+    `program_cost` is sharding-unaware (whole batch on one device)
+    while the comm report's times are per-device — pass `devices` (the
+    mesh size) to put compute/HBM on the same per-device footing
+    (perfect-split assumption, i.e. the roofline stays a lower bound)."""
+    devices = max(int(devices), 1)
+    out = dict(report)
+    t_compute = report["compute_time_s"] / devices
+    t_memory = report["memory_time_s"] / devices
+    t_comm = float(comm.get("comm_time_s", 0.0))
+    step = max(t_compute, t_memory, t_comm)
+    bounds = [("compute", t_compute), ("memory", t_memory),
+              ("comm", t_comm)]
+    out["devices"] = devices
+    out["compute_time_s"] = t_compute
+    out["memory_time_s"] = t_memory
+    out["comm_time_s"] = t_comm
+    out["collective_bytes"] = int(report.get("collective_bytes", 0)
+                                  or comm.get("collective_bytes", 0))
+    out["predicted_step_time_s"] = step
+    out["predicted_bound"] = max(bounds, key=lambda kv: kv[1])[0]
+    out["mfu_ceiling"] = (t_compute / step) if step else 0.0
+    out["comm_per_kind"] = comm.get("per_kind", {})
+    return out
 
 
 def render(report: dict, top: int = 8) -> str:

@@ -101,6 +101,24 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "memory_optimize marked grad ops for rematerialization but the "
          "projected HBM peak did not drop — remat FLOPs paid for no "
          "memory win (quantified memory_optimize contract)"),
+    Rule("PTV018", "sharding-conflict", ERROR,
+         "two sharding rules force incompatible specs on one variable "
+         "(one mesh axis claimed by two dims, or an explicit constraint "
+         "contradicting the rule-derived spec) — no device assignment "
+         "satisfies the plan"),
+    Rule("PTV019", "implicit-reshard", WARNING,
+         "sharding propagation had to insert a reshard of a TRANSIENT "
+         "value at an op boundary: the gather is re-paid every step "
+         "(feeds/params reshard once at distribution time and are "
+         "exempt)"),
+    Rule("PTV020", "replicated-large-tensor", INFO,
+         "a large tensor is left fully replicated although a mesh axis "
+         "divides one of its dims — a sharding rule could cut its "
+         "per-device residency by the axis size"),
+    Rule("PTV021", "dcn-crossing-collective", WARNING,
+         "a collective inside the inner step spans a DCN mesh axis "
+         "('dcn' name prefix, parallel/mesh.py): DCN bandwidth is ~10x "
+         "below ICI, so per-step collectives must stay intra-slice"),
 ]}
 
 # ops the executor skips (framework/executor.py _NOOP_TYPES) plus desc-only
@@ -384,17 +402,21 @@ def _check_donation_races(program, donated):
                     break
 
 
-def _check_sharded_donation(program, donated, plan):
+def _check_sharded_donation(program, donated, plan, provenance=None):
     """PTV016: donated rw-state sharded over >=1 mesh axis under `plan`.
     Sharded-ness is judged by NAMED AXES in the spec, not the byte
     divisor: a bare PartitionSpec carries no mesh (divisor would be 1)
     yet still declares the var sharded — the rule must not go silently
     inert on that documented input.  A NamedSharding whose named axes
-    all have size 1 is effectively replicated and exempt."""
+    all have size 1 is effectively replicated and exempt.  `provenance`
+    ({var: "which rule produced this spec"}, from
+    `ParallelExecutor.static_plan(provenance=...)`) pins each finding to
+    the AXIS RULE that made the state sharded."""
     from .memory import shard_divisor, _spec_entries
 
     if not plan:
         return
+    provenance = provenance or {}
     for b in program.blocks:
         if b.parent_idx >= 0:
             continue
@@ -408,11 +430,13 @@ def _check_sharded_donation(program, donated, plan):
             if getattr(sh, "mesh", None) is not None \
                     and shard_divisor(sh) <= 1:
                 continue  # size-1 axes: replicated in practice
+            src = provenance.get(name)
             yield Finding(
                 "PTV016",
                 f"donated state sharded over axes {axes} — host "
                 f"materialization of a stale handle after the step can "
-                f"abort natively",
+                f"abort natively"
+                + (f" (sharded by rule: {src})" if src else ""),
                 block=b.idx, var=name)
 
 
@@ -651,7 +675,8 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
                    rules: Optional[Iterable[str]] = None,
                    suppress: Iterable[str] = (),
                    check_shapes: bool = True,
-                   plan: Optional[dict] = None) -> Report:
+                   plan: Optional[dict] = None,
+                   plan_provenance: Optional[dict] = None) -> Report:
     """Run the rule engine over `program`; returns a `Report`.
 
     feed_names/fetch_names give the run context (PTV003/PTV004/PTV010 need
@@ -661,7 +686,11 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
     ``__verify_suppress__`` attr.  `check_shapes=False` skips the abstract
     eval (PTV006) for desc-only speed.  `plan` ({var: NamedSharding /
     PartitionSpec}, e.g. `ParallelExecutor.static_plan(program)`) arms the
-    sharded-donation rule (PTV016) for SPMD programs."""
+    sharded-donation rule (PTV016) AND the sharding-propagation family
+    (PTV018-PTV021, analysis/sharding.py) for SPMD programs;
+    `plan_provenance` ({var: rule description}, from
+    `static_plan(provenance=...)`) names the axis rule inside PTV016
+    findings."""
     feed_names = list(feed_names) if feed_names is not None else None
     fetch_names = list(fetch_names) if fetch_names is not None else None
     enabled = set(rules) if rules is not None else set(RULES)
@@ -697,7 +726,16 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
             findings.extend(_check_donation_races(program, donated))
         if want("PTV016"):
             findings.extend(_check_sharded_donation(program, donated,
-                                                    plan))
+                                                    plan,
+                                                    plan_provenance))
+    if plan and any(want(r) for r in ("PTV018", "PTV019", "PTV020",
+                                      "PTV021")):
+        from .sharding import sharding_findings
+
+        got, _ = sharding_findings(program, plan, batch_size=batch_size,
+                                   block_id=block_id,
+                                   provenance=plan_provenance)
+        findings.extend(f for f in got if want(f.rule))
     if want("PTV006") and check_shapes \
             and not any(f.rule in ("PTV001", "PTV002") for f in findings):
         # abstract eval assumes a lowerable block; structural errors first

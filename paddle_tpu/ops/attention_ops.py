@@ -519,3 +519,46 @@ def _paged_prefill_cost(ins, outs, attrs):
 
 
 register_cost("paged_prefill", _paged_prefill_cost)
+
+
+# ---------------------------------------------------------------------------
+# sharding-propagation rule (analysis/sharding.py; mechanism in registry)
+
+from .registry import register_sharding  # noqa: E402
+
+
+def _sdpa_sharding(ctx, ins, outs, attrs):
+    """Sequence-parallel attention comm: 'ring' rotates K/V chunks over
+    (sp-1) collective-permute hops; 'alltoall' (Ulysses) reshards
+    seq→heads and back with one all-to-all pair around the dense local
+    attention.  Both live inside shard_map custom_vjps, so the backward
+    re-pays them (bwd_retrace) — the dK/dV return rotation makes ring's
+    backward ~2x the forward, priced as a second chunk set."""
+    q = ins.get("Q", [None])[0]
+    k = ins.get("K", [None])[0]
+    v = ins.get("V", [None])[0]
+    out = outs.get("Out", [None])[0]
+    if q is None or out is None:
+        return {}
+    spec = tuple(q.spec)
+    sp = ctx.axis_size("sp")
+    if sp > 1 and k is not None and v is not None:
+        kv_chunk = (k.device_bytes(ctx.analysis.axis_sizes)
+                    + v.device_bytes(ctx.analysis.axis_sizes)) // sp
+        if str(attrs.get("sp_mode", "ring")) == "alltoall":
+            per = sum(t.device_bytes(ctx.analysis.axis_sizes) // sp
+                      for t in (q, k, v))
+            ctx.collective("all-to-all", ("sp",), per + kv_chunk,
+                           var=out.name,
+                           why="Ulysses seq→heads scatter + heads→seq "
+                               "gather", scales_with_axes=True)
+        else:
+            ctx.collective("collective-permute", ("sp",),
+                           (sp - 1) * kv_chunk, var=out.name,
+                           why=f"ring K/V rotation ({sp - 1} hops)",
+                           scales_with_axes=True)
+    return {"Out": [spec]}
+
+
+_sdpa_sharding.bwd_retrace = True
+register_sharding("scaled_dot_product_attention", _sdpa_sharding)

@@ -153,7 +153,7 @@ def _moe_sharded(ctx, x, gate_w, wi, wo, mesh, token_axes, factor, act):
 # ---------------------------------------------------------------------------
 # analytic cost formula (analysis/cost.py; mechanism in registry.py)
 
-from .registry import register_cost  # noqa: E402
+from .registry import register_cost, register_sharding  # noqa: E402
 
 
 def _moe_cost(ins, outs, attrs):
@@ -179,3 +179,26 @@ def _moe_cost(ins, outs, attrs):
 
 
 register_cost("moe", _moe_cost)
+
+
+def _moe_sharding(ctx, ins, outs, attrs):
+    """Expert-parallel dispatch: tokens ride an all_to_all to their
+    expert's member and back (2x the send buffer each direction); the
+    shard_map custom path re-pays both in the backward (bwd_retrace),
+    matching the cost formula's collective_bytes above."""
+    x = ins.get("X", [None])[0]
+    out = outs.get("Out", [None])[0]
+    if x is None or out is None:
+        return {}
+    ep = ctx.axis_size("ep")
+    if ep > 1:
+        ctx.collective("all-to-all", ("ep",),
+                       2 * x.device_bytes(ctx.analysis.axis_sizes),
+                       var=out.name,
+                       why="token dispatch + return over the expert "
+                           "axis", scales_with_axes=True)
+    return {"Out": [tuple(x.spec)]}
+
+
+_moe_sharding.bwd_retrace = True
+register_sharding("moe", _moe_sharding)

@@ -423,7 +423,7 @@ def pruning_mask(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 # analytic cost formula (analysis/cost.py; mechanism in registry.py)
 
-from .registry import register_cost  # noqa: E402
+from .registry import register_cost, register_sharding  # noqa: E402
 
 
 def _lookup_table_cost(ins, outs, attrs):
@@ -441,3 +441,44 @@ def _lookup_table_cost(ins, outs, attrs):
 
 
 register_cost("lookup_table", _lookup_table_cost)
+
+
+def _lookup_table_sharding(ctx, ins, outs, attrs):
+    """Vocab-sharded embedding: a table sharded over a FREE mesh axis
+    is looked up masked-locally and the output all-reduced over that
+    axis (the mp vocab path); a table sharded over the ids' own batch
+    axis (FSDP) is all-gathered instead — the calibrated GSPMD pair."""
+    from ..analysis.sharding import entry_axes
+
+    w = ins.get("W", [None])[0]
+    ids = ins.get("Ids", [None])[0]
+    out = outs.get("Out", [None])[0]
+    if w is None or out is None:
+        return {}
+    batch = set(entry_axes(ids.spec[0])) if ids is not None and ids.spec \
+        else set()
+    vocab = w.spec[0] if w.spec else None
+    lead = ids.spec[0] if ids is not None and ids.spec else None
+    ndim = len(out.shape)
+    spec = ((lead,) + (None,) * max(0, ndim - 2)
+            + ((w.spec[-1],) if ndim >= 2 and len(w.spec) >= 2 else ()))
+    spec = tuple(spec[:ndim])
+    for a in entry_axes(vocab):
+        if ctx.axis_size(a) <= 1:
+            continue
+        if a in batch:
+            ctx.collective("all-gather", (a,), w.global_bytes,
+                           var=w.name,
+                           why="table sharded over the batch axis is "
+                               "gathered for the lookup")
+        else:
+            ctx.collective("all-reduce", (a,),
+                           ctx.device_bytes(out.name, spec),
+                           var=out.name,
+                           why="masked lookup over the sharded vocab "
+                               "dim leaves partial rows",
+                           scales_with_axes=True)
+    return {"Out": [spec]}
+
+
+register_sharding("lookup_table", _lookup_table_sharding)

@@ -1,4 +1,4 @@
-"""Device mesh construction (SPMD over ICI).
+"""Device mesh construction (SPMD over ICI) and the PartitionSpec mint.
 
 Replaces all four reference communication backends (SURVEY.md §2.16/§5):
 NCCL collective ops (operators/nccl_op.cc), the C++ socket pserver
@@ -10,7 +10,17 @@ Axis names:
   dp — data parallel (batch axis)
   mp — model/tensor parallel (hidden/vocab axes)
   sp — sequence parallel (long-context time axis)
-  pp — pipeline stages (reserved)
+  pp — pipeline stages
+  dcn* — a "dcn" prefix marks an axis as crossing the data-center
+         network instead of ICI (multi-slice meshes); the sharding
+         analyzer prices its collectives at DCN bandwidth and PTV021
+         flags inner-step collectives that cross it
+
+This module is the ONLY place in `paddle_tpu/parallel/` allowed to
+construct `PartitionSpec` literals (enforced by tools/repo_lint.py):
+every other module derives specs through `pspec`/`named`/`replicated`,
+so the sharding analyzer can trust that whatever plan it is handed was
+minted by rules, not ad-hoc tuples.
 """
 
 from __future__ import annotations
@@ -22,6 +32,41 @@ def axis_size(mesh, name: str, default: int = 1) -> int:
     """Size of mesh axis `name` (`default` when the mesh has no such
     axis) — the one place for the name→size lookup."""
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, default)
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """{axis name: size} for every axis of `mesh`."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def dcn_axes(mesh_or_axes) -> tuple:
+    """Axis names that cross DCN rather than ICI, by the naming
+    convention (a ``dcn`` prefix): hybrid multi-slice meshes name their
+    slow axis ``dcn``/``dcn_dp``/... so both the executor and the
+    static comm analyzer agree on which links a collective rides."""
+    names = getattr(mesh_or_axes, "axis_names", mesh_or_axes)
+    return tuple(n for n in names if str(n).startswith("dcn"))
+
+
+def pspec(*entries):
+    """The PartitionSpec mint: one constructor site for all of
+    parallel/ (trailing Nones are harmless; jax treats missing and None
+    entries identically)."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*entries)
+
+
+def named(mesh, *entries):
+    """NamedSharding over `mesh` with spec entries `entries`."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, pspec(*entries))
+
+
+def replicated(mesh):
+    """Fully-replicated NamedSharding over `mesh`."""
+    return named(mesh)
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):

@@ -74,8 +74,8 @@ def build_moe_train_step(mesh, d_model: int, d_hidden: int, capacity: int,
     experts sharded over `ep`, tokens over `dp`."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from .mesh import get_shard_map
+    from jax.sharding import NamedSharding
+    from .mesh import get_shard_map, pspec as P
 
     shard_map = get_shard_map()
 

@@ -21,6 +21,7 @@ from ..framework.core import np_dtype
 from ..framework.executor import Executor, _lower_ops
 from ..framework.scope import global_scope
 from ..ops.registry import EmitContext
+from . import mesh as mesh_lib
 from .mesh import make_mesh
 from .transpiler import DistributeTranspiler, ShardingRules
 
@@ -86,34 +87,35 @@ class ParallelExecutor(Executor):
         return plan
 
     def _replicated(self):
-        from jax.sharding import NamedSharding, PartitionSpec
+        return mesh_lib.replicated(self.mesh)
 
-        return NamedSharding(self.mesh, PartitionSpec())
-
-    def _shard_of(self, plan, name):
+    def _shard_of(self, plan, name, prov=None):
         s = plan.get(name)
         if s is not None:
-            return self._maybe_zero_shard(name, s)
+            return self._maybe_zero_shard(name, s, prov)
         # optimizer accumulators follow their parameter (positive tag from
         # Optimizer._add_accumulator, carried on the VarDesc)
         owner = self._accum_owner.get(name)
         if owner is not None and owner in plan:
-            return self._maybe_zero_shard(name, plan[owner])
+            if prov is not None:
+                prov[name] = (f"accumulator follows parameter "
+                              f"{owner!r}")
+            return self._maybe_zero_shard(name, plan[owner], prov)
         return self._replicated()
 
-    def _maybe_zero_shard(self, name, sharding):
+    def _maybe_zero_shard(self, name, sharding, prov=None):
         """ZeRO-1: shard an optimizer accumulator (a var positively tagged
         by the optimizer) over the replica axis on dim 0 when divisible.
         ZeRO-3 (fsdp_params): trainable parameters shard the same way —
         GSPMD then all-gathers them for compute and reduce-scatters their
-        gradients, giving 1/dp weight residency with identical numerics."""
+        gradients, giving 1/dp weight residency with identical numerics.
+        `prov` (optional dict) collects WHICH rule produced each spec —
+        the static_plan provenance the PTV016 findings cite."""
         if not self.zero_dp_states:
             return sharding
         if name not in self._accum_owner and not (
                 self.fsdp_params and name in self._trainable_params):
             return sharding
-        from jax.sharding import NamedSharding, PartitionSpec
-
         rules = self.transpiler.rules
         dp_axis = rules.dp_axis
         dp = rules._axis_size(self.mesh, dp_axis)
@@ -122,8 +124,15 @@ class ParallelExecutor(Executor):
         if (dp > 1 and shape and len(shape) >= 1
                 and shape[0] % dp == 0 and shape[0] >= dp
                 and (not spec or spec[0] is None)):
-            new_spec = (dp_axis,) + tuple(spec[1:] if spec else ())
-            return NamedSharding(self.mesh, PartitionSpec(*new_spec))
+            if prov is not None:
+                kind = ("FSDP/ZeRO-3 parameter shard"
+                        if name in self._trainable_params
+                        and self.fsdp_params
+                        else "ZeRO-1 accumulator reshard")
+                prov[name] = (f"{kind} over {dp_axis!r} on dim 0 "
+                              f"(axis size {dp})")
+            return mesh_lib.named(self.mesh, dp_axis,
+                                  *(spec[1:] if spec else ()))
         return sharding
 
     def _state_shape(self, name):
@@ -142,12 +151,17 @@ class ParallelExecutor(Executor):
                 return tuple(dv.shape)
         return None
 
-    def static_plan(self, program, block_id: int = 0):
+    def static_plan(self, program, block_id: int = 0, provenance=None):
         """EFFECTIVE per-variable shardings — the transpiler plan plus
         the ZeRO-1/FSDP accumulator+parameter resharding — from descs
         alone: no scope, no compilation, nothing runs.  This is the
         `plan=` input to `analysis.verify_program` (sharded-donation
-        rule PTV016) and `analysis.memory.peak_estimate(per-shard)`."""
+        rule PTV016, sharding-propagation rules PTV018-021),
+        `analysis.memory.peak_estimate(per-shard)`, and
+        `analysis.sharding.propagate`.  Pass `provenance={}` to collect
+        {var: which rule produced the spec} — verify_program's
+        `plan_provenance` input, so PTV016 findings name the axis rule
+        that made the donated state sharded."""
         block = program.blocks[block_id]
         plan = self._plan_for(program)
         self._desc_block = block
@@ -165,7 +179,12 @@ class ParallelExecutor(Executor):
                     # replicated placeholder here would override the
                     # estimator's batch-led heuristic with a lie
                     continue
-                out[n] = self._shard_of(plan, n)
+                out[n] = self._shard_of(plan, n, provenance)
+                if provenance is not None and n not in provenance:
+                    spec = tuple(out[n].spec)
+                    if any(e for e in spec):
+                        provenance[n] = self.transpiler.rules.describe(
+                            v, spec)
             return out
         finally:
             self._desc_block = None

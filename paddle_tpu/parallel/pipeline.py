@@ -97,8 +97,8 @@ def build_pipeline_train_step(mesh, n_micro: int, width: int,
     with params sharded over the mesh's `pp` axis and data over `dp`."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from .mesh import get_shard_map
+    from jax.sharding import NamedSharding
+    from .mesh import get_shard_map, pspec as P
 
     shard_map = get_shard_map()
 
@@ -127,5 +127,5 @@ def build_pipeline_train_step(mesh, n_micro: int, width: int,
             lambda p, g: p - lr * g, params, grads)
         return loss, new_params
 
-    shard = NamedSharding(mesh, jax.sharding.PartitionSpec("pp"))
+    shard = NamedSharding(mesh, P("pp"))
     return jax.jit(train_step), shard

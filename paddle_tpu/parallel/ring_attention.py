@@ -470,7 +470,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     from .mesh import get_shard_map
 
     shard_map = get_shard_map()
-    from jax.sharding import PartitionSpec as P
+    from .mesh import pspec as P
 
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -603,9 +603,7 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
     flash kernel — the training custom_vjp pair when `is_train`."""
     import functools
 
-    from jax.sharding import PartitionSpec as P
-
-    from .mesh import get_shard_map
+    from .mesh import get_shard_map, pspec as P
 
     from .mesh import axis_size
 

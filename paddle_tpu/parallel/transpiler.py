@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from .mesh import pspec
+
 
 class ShardingRules:
     def __init__(self, dp_axis="dp", mp_axis="mp", sp_axis="sp",
@@ -35,31 +37,40 @@ class ShardingRules:
         return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
 
     def feed_spec(self, mesh, var):
-        from jax.sharding import PartitionSpec as P
-
         if self._axis_size(mesh, self.dp_axis) <= 1:
-            return P()
+            return pspec()
         ndim = len(var.shape or ())
         if ndim == 0:
-            return P()
-        return P(self.dp_axis, *([None] * (ndim - 1)))
+            return pspec()
+        return pspec(self.dp_axis, *([None] * (ndim - 1)))
 
     def param_spec(self, mesh, name: str, shape, embedding_names=()):
-        from jax.sharding import PartitionSpec as P
-
         mp = self._axis_size(mesh, self.mp_axis)
         if not self.shard_params or mp <= 1 or shape is None:
-            return P()
+            return pspec()
         shape = tuple(int(s) for s in shape)
         if len(shape) < self.min_shard_dim:
-            return P()
+            return pspec()
         if name in embedding_names and shape[0] % mp == 0:
             # vocab-sharded embedding table
-            return P(self.mp_axis, *([None] * (len(shape) - 1)))
+            return pspec(self.mp_axis, *([None] * (len(shape) - 1)))
         if len(shape) == 2 and shape[-1] % mp == 0 and shape[-1] >= 128:
             # column-parallel dense weight
-            return P(*([None] * (len(shape) - 1)), self.mp_axis)
-        return P()
+            return pspec(*([None] * (len(shape) - 1)), self.mp_axis)
+        return pspec()
+
+    def describe(self, var, spec) -> str:
+        """Human name of the rule that produced `spec` for `var` — the
+        provenance string static_plan collects and PTV016 cites."""
+        spec = tuple(spec)
+        if getattr(var, "is_data", False):
+            return (f"feed batch rule ({self.dp_axis!r} on dim 0)")
+        if spec and spec[0] is not None:
+            return (f"vocab/dim-0 shard rule ({spec[0]!r} on dim 0)")
+        if spec and spec[-1] is not None:
+            return (f"column-parallel rule ({spec[-1]!r} on the last "
+                    f"dim)")
+        return "transpiler rule"
 
 
 class DistributeTranspiler:

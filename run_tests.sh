@@ -13,6 +13,13 @@ cd "$(dirname "$0")"
 python tools/repo_lint.py
 JAX_PLATFORMS=cpu python tools/lint_smoke.py
 
+# sharding gate (docs/analysis.md ISSUE 9): the static sharding
+# analyzer over all 11 dryrun parallelism modes — exits 1 on any
+# PTV018 (sharding conflict) or PTV019 (hot-loop implicit reshard)
+# finding; desc-only, nothing compiles
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m paddle_tpu analyze --sharding > /dev/null
+
 # serving smoke (docs/serving.md): tiny-model continuous batching on CPU
 # with the verifier armed, then `paddle_tpu lint` over the engine-built
 # prefill/decode programs so the PR 6 verifier covers the serving tier

@@ -200,7 +200,7 @@ def test_suppression_per_op_and_per_call():
 
 def test_rule_catalog_stable():
     """IDs are load-bearing (suppressions, CI greps): assert the catalog."""
-    assert [r for r in RULES] == [f"PTV{i:03d}" for i in range(1, 18)]
+    assert [r for r in RULES] == [f"PTV{i:03d}" for i in range(1, 22)]
     assert RULES["PTV001"].severity == "error"
     assert RULES["PTV003"].severity == "warning"
     assert RULES["PTV009"].severity == "warning"
@@ -208,6 +208,10 @@ def test_rule_catalog_stable():
     assert RULES["PTV015"].severity == "warning"
     assert RULES["PTV016"].severity == "warning"
     assert RULES["PTV017"].severity == "error"
+    assert RULES["PTV018"].severity == "error"
+    assert RULES["PTV019"].severity == "warning"
+    assert RULES["PTV020"].severity == "info"
+    assert RULES["PTV021"].severity == "warning"
 
 
 def test_donated_overwrite_race_ptv015():
@@ -313,9 +317,11 @@ def test_known_crash_parallel_programs_flagged_ptv016():
     for name, cfg in configs:
         loss, prog = momentum_mlp()
         pe = ParallelExecutor(**cfg)
-        plan = pe.static_plan(prog)
+        provenance = {}
+        plan = pe.static_plan(prog, provenance=provenance)
         rep = verify_program(prog, feed_names=["x", "y"],
                              fetch_names=[loss.name], plan=plan,
+                             plan_provenance=provenance,
                              check_shapes=False)
         hits = [f for f in rep.findings if f.rule == "PTV016"]
         assert hits, f"{name}: no PTV016 finding\n{rep.render()}"
@@ -324,6 +330,15 @@ def test_known_crash_parallel_programs_flagged_ptv016():
         # params under fsdp, velocity accumulators under zero
         assert any("velocity" in v or "fc_" in v for v in flagged), \
             (name, flagged)
+        # ISSUE 9: each finding pinpoints WHICH axis rule sharded the
+        # donated state (the ZeRO/FSDP reshard, via static_plan
+        # provenance routed through the new sharding rule engine)
+        assert all("sharded by rule" in f.message for f in hits), \
+            [f.message for f in hits]
+        expect = ("FSDP/ZeRO-3 parameter shard" if cfg.get("fsdp_params")
+                  else "ZeRO-1 accumulator reshard")
+        assert any(expect in f.message for f in hits), \
+            (name, expect, [f.message for f in hits])
 
 
 def test_memory_optimize_quantified_reduction():
@@ -886,3 +901,27 @@ def test_repo_lint_flags_direct_compiler_params(tmp_path):
     (pkg / "rogue_kernel.py").write_text(
         f"params = pltpu.{cls_old}()\n")
     assert any("rogue_kernel.py:1" in f for f in rl.lint(str(tmp_path)))
+
+
+def test_repo_lint_flags_partition_spec_in_parallel(tmp_path):
+    """The rule-derived-specs guard: PartitionSpec named anywhere in
+    paddle_tpu/parallel/ outside mesh.py (construction OR import alias)
+    is flagged; mesh.py itself is the blessed mint."""
+    rl = _repo_lint_module()
+
+    pkg = tmp_path / "paddle_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    for d in (tmp_path / "paddle_tpu", pkg):
+        (d / "__init__.py").write_text("")
+    cls = "Partition" + "Spec"
+    (pkg / "mesh.py").write_text(
+        f"def pspec(*e):\n"
+        f"    from jax.sharding import {cls}\n"
+        f"    return {cls}(*e)\n")
+    assert rl.lint(str(tmp_path)) == []
+    (pkg / "rogue_mode.py").write_text(
+        f"from jax.sharding import {cls} as P\n"
+        f"spec = P('dp')\n")
+    findings = rl.lint(str(tmp_path))
+    assert any("PartitionSpec literal in parallel/" in f
+               and "rogue_mode.py:1" in f for f in findings), findings

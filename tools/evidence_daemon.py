@@ -232,6 +232,12 @@ CAPTURES = [
     ("roofline_decomposition",
      [sys.executable, "tools/hlo_analysis.py", "roofline", "--bs", "128",
       "--tpu"], {}, 900),
+    # comm profile (ISSUE 9): the static sharding analyzer's predicted
+    # collective set/bytes vs the collectives in the on-chip
+    # optimized_hlo, per parallelism mode — static-vs-actual is the
+    # trust anchor for the comm-aware roofline's scaling curves
+    ("comm_profile",
+     [sys.executable, "tools/hlo_analysis.py", "comm"], {}, 1500),
     ("unet",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "unet", "BENCH_ITERS": "10"}, 580),

@@ -26,6 +26,16 @@ Usage:
                                          # ResNet-50: static cost-model
                                          # prediction vs measured step
                                          # time/MFU (evidence capture)
+  python tools/hlo_analysis.py comm [--mode NAME]
+                                         # sharding analyzer validation:
+                                         # STATIC predicted collectives
+                                         # (analysis/sharding.py) vs the
+                                         # ACTUAL collectives in
+                                         # optimized_hlo, per parallelism
+                                         # mode (paddle_tpu.parallel.modes
+                                         # catalog + the lm_dp/lm_mp/
+                                         # lm_fsdp acceptance trio); one
+                                         # static-vs-actual JSON line each
   python tools/hlo_analysis.py all   # bytes+collectives, JSON per line
 
 The workload runs in a re-exec'd child with XLA_FLAGS=--xla_dump_to so
@@ -483,6 +493,141 @@ def child_collectives(mode: str) -> None:
     print("CHILD_OK")
 
 
+# --------------------------------------------------------------- comm mode
+def comm_validation_programs():
+    """The ISSUE 9 acceptance trio: the small-LM train step under dp,
+    mp (dp×mp), and fsdp — (name, executor_kwargs, feed_fn).  The test
+    suite asserts the static analyzer's collective SET matches the
+    optimized_hlo truth exactly on these, bytes within ±10%."""
+
+    def build():
+        from paddle_tpu.models.transformer import build_lm_train_program
+
+        return build_lm_train_program(seq_len=16, vocab_size=64, dim=32,
+                                      n_layers=1, n_heads=2,
+                                      dtype="float32").name
+
+    def feed(rng, bs):
+        import numpy as np
+
+        toks = rng.randint(0, 64, (bs, 16, 1)).astype("int64")
+        return {"tokens": toks, "targets": np.roll(toks, -1, axis=1)}
+
+    return [
+        ("lm_dp", build, dict(axes={"dp": 8}), feed),
+        ("lm_mp", build, dict(axes={"dp": 4, "mp": 2}), feed),
+        ("lm_fsdp", build, dict(axes={"dp": 8}, fsdp_params=True), feed),
+    ]
+
+
+def _comm_mode_entry(name):
+    """(build_fn, executor_kwargs, feed_fn, pipeline) for `name` — a
+    catalog mode or one of the lm_* validation configs."""
+    for vname, build, cfg, feed in comm_validation_programs():
+        if vname == name:
+            return build, cfg, feed, False
+    from paddle_tpu.parallel import modes as pmodes
+
+    m = pmodes.get_mode(name)
+    cfg = dict(m.executor_kwargs)
+    cfg["axes"] = dict(m.mesh_axes)
+    return m.build, cfg, m.feed_fn, m.pipeline
+
+
+def comm_static(name, batch_size=8):
+    """Static side: build the mode's program, derive the plan, run the
+    sharding propagation — desc-only, returns (per_kind, analysis)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import sharding as ash
+    from paddle_tpu.parallel import ParallelExecutor
+    from paddle_tpu.parallel import modes as pmodes
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    pmodes.ensure_virtual_devices(8)
+    build, cfg, _, pipeline = _comm_mode_entry(name)
+    fluid.reset()
+    build()
+    program = fluid.default_main_program()
+    if pipeline:
+        mesh = make_mesh(cfg["axes"])
+        ana = ash.propagate(program, mesh=mesh, plan={},
+                            batch_size=batch_size)
+    else:
+        pe = ParallelExecutor(**cfg)
+        plan = pe.static_plan(program)
+        ana = ash.propagate(program, plan=plan, batch_size=batch_size)
+    return ana.per_kind(), ana
+
+
+def child_comm(name, bs=8):
+    """One training step of mode `name` on the 8-virtual-CPU mesh;
+    always writes optimized_hlo text where find_main_module looks (the
+    persistent compile cache suppresses --xla_dump_to on cache hits)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import ParallelExecutor
+
+    build, cfg, feed_fn, pipeline = _comm_mode_entry(name)
+    if pipeline:
+        print("CHILD_SKIP pipeline mode has no ParallelExecutor HLO")
+        return
+    rng = np.random.RandomState(0)
+    fluid.reset()
+    loss_name = build()
+    pe = ParallelExecutor(**cfg)
+    pe.run(fluid.default_startup_program())
+    dp = cfg["axes"].get("dp", 1)
+    feed = feed_fn(rng, max(dp * 2, 8))
+    pe.run(feed=feed, fetch_list=[loss_name])
+    txt = pe.optimized_hlo(feed=feed, fetch_list=[loss_name])
+    text_dir = os.environ.get("PDTPU_HLO_TEXT_DIR")
+    if text_dir:
+        with open(os.path.join(
+                text_dir, "pjrt_module.after_optimizations.txt"),
+                "w") as f:
+            f.write(txt)
+    print("CHILD_OK")
+
+
+def run_comm(args) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.parallel.modes import MODE_NAMES
+
+    names = ([args.submode] if args.submode else
+             [n for n, *_ in comm_validation_programs()]
+             + list(MODE_NAMES))
+    for name in names:
+        static, ana = comm_static(name)
+        rec = {"analysis": "comm", "mode": name,
+               "static": {k: dict(v) for k, v in static.items()}}
+        _, _, _, pipeline = _comm_mode_entry(name)
+        if pipeline:
+            rec["actual"] = None
+            rec["note"] = ("pipeline modes run through ProgramPipeline, "
+                           "not ParallelExecutor — no step HLO to parse; "
+                           "static side only")
+            print(json.dumps(rec), flush=True)
+            continue
+        with tempfile.TemporaryDirectory(prefix=f"comm_{name}_") as dump:
+            args.submode = name
+            run_child("comm", dump, args)
+            module = find_main_module(dump, COLLECTIVES)
+            _, _, colls = parse_module(module)
+        actual = {}
+        for c in colls:
+            e = actual.setdefault(c["op"], {"count": 0, "bytes": 0})
+            e["count"] += 1
+            e["bytes"] += c["out_bytes"]
+        rec["actual"] = actual
+        rec["set_match"] = set(static) == set(actual)
+        rec["byte_ratio"] = {
+            k: round(static.get(k, {}).get("bytes", 0)
+                     / max(actual.get(k, {}).get("bytes", 0), 1), 4)
+            for k in set(static) | set(actual)}
+        print(json.dumps(rec), flush=True)
+
+
 # ------------------------------------------------------------------ driver
 def analyze(mode: str, args) -> dict:
     with tempfile.TemporaryDirectory(prefix=f"hlo_{mode}_") as dump:
@@ -549,7 +694,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
                     choices=["bytes", "collectives", "peak", "roofline",
-                             "all"])
+                             "comm", "all"])
     ap.add_argument("--child", default=None)
     ap.add_argument("--mode", dest="submode", default=None)
     ap.add_argument("--bs", type=int, default=32)
@@ -569,6 +714,8 @@ def main():
             child_bytes(args)
         elif args.child == "roofline":
             child_roofline(args)
+        elif args.child == "comm":
+            child_comm(args.submode)
         else:
             child_collectives(args.submode)
         return
@@ -578,6 +725,9 @@ def main():
         return
     if args.what == "roofline":
         analyze_roofline(args)
+        return
+    if args.what == "comm":
+        run_comm(args)
         return
     if args.what in ("bytes", "all"):
         for fuse in ((False, True) if args.what == "all"

@@ -55,22 +55,34 @@ def main():
         flat = [last] + [x for x in last.get("extra_metrics", [])
                          if isinstance(x, dict)]
         for r in flat:
-            if r.get("unit") == "error" or not r.get("metric"):
+            if r.get("unit") == "error" or not (r.get("metric")
+                                                or r.get("analysis")):
                 continue
             ok_rows.append((utc, name, r))
 
     print("| capture | metric | value | unit | vs baseline | mfu "
-          "| p50/p99 ms |")
-    print("|---|---|---|---|---|---|---|")
+          "| p50/p99 ms | comm |")
+    print("|---|---|---|---|---|---|---|---|")
     for utc, name, r in ok_rows:
         # serving rows (tools/serve_bench.py) carry request-latency
         # percentiles beside the throughput headline
         pct = r.get("percentiles") or {}
         ptxt = (f"{pct.get('p50_ms', '')}/{pct.get('p99_ms', '')}"
                 if pct else "")
-        print(f"| {name} | {r['metric']} | {r.get('value')} "
+        # comm_profile rows (tools/hlo_analysis.py comm): per-kind
+        # static-vs-actual collective breakdown, compacted
+        ctxt = ""
+        if r.get("analysis") == "comm":
+            kinds = sorted(set(r.get("static") or {})
+                           | set(r.get("actual") or {}))
+            ctxt = "; ".join(
+                f"{k} {((r.get('byte_ratio') or {}).get(k, ''))}"
+                for k in kinds)
+            ctxt += " (static/actual bytes)" if ctxt else ""
+        print(f"| {name} | {r.get('metric', r.get('mode', ''))} "
+              f"| {r.get('value')} "
               f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
-              f"| {r.get('mfu', '')} | {ptxt} |")
+              f"| {r.get('mfu', '')} | {ptxt} | {ctxt} |")
     if failed:
         print("\nFailed/empty captures:")
         for name, err in failed:

@@ -12,6 +12,12 @@ Three classes of rot this repo has actually accumulated:
      failed on it); every kernel must go through
      ``ops/pallas_kernels/_common.compiler_params()``, which resolves
      the name at runtime.  Only _common.py may touch the class.
+  4. ``PartitionSpec`` literals inside ``paddle_tpu/parallel/`` outside
+     ``mesh.py`` — specs must stay RULE-DERIVED (minted by
+     ``mesh.pspec``/``named``/``replicated``) so the sharding analyzer
+     (analysis/sharding.py) can trust every plan it is handed; an
+     ad-hoc spec tuple in a mode file is exactly the bespoke wiring the
+     logical-axis refactor (ROADMAP #2) is collapsing.
 
 Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
 any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
@@ -59,6 +65,37 @@ def _check_compiler_params(root, dirpath, filenames, findings):
             pass
 
 
+# the rule-derived-specs guard: PartitionSpec named (constructed OR
+# imported, aliasing included) anywhere in parallel/ except the mint
+_PARTITION_SPEC_RE = re.compile(r"\bPartition" + r"Spec\b(?!`)")
+_PARTITION_SPEC_DIR = os.path.join("paddle_tpu", "parallel")
+_PARTITION_SPEC_OK = os.path.join(_PARTITION_SPEC_DIR, "mesh.py")
+
+
+def _check_partition_spec(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    if not rel_dir.startswith(_PARTITION_SPEC_DIR):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel == _PARTITION_SPEC_OK:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _PARTITION_SPEC_RE.search(line):
+                        findings.append(
+                            f"PartitionSpec literal in parallel/: "
+                            f"{rel}:{i} (mint specs via parallel/"
+                            f"mesh.py pspec()/named()/replicated() so "
+                            f"they stay rule-derived)")
+        except OSError:
+            pass
+
+
 def _source_for(pyc_name: str) -> str:
     """foo.cpython-310.pyc -> foo.py (also plain foo.pyc)."""
     base = pyc_name.split(".")[0]
@@ -93,6 +130,7 @@ def lint(root: str):
             dirnames[:] = []
             continue
         _check_compiler_params(root, dirpath, filenames, findings)
+        _check_partition_spec(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)
