@@ -19,6 +19,10 @@ Layers:
   memory.py    — static HBM-peak estimator (remat/donation/shard-aware)
   sharding.py  — logical-axis rules, sharding propagation, reshard/
                  conflict detection (PTV018-021), comm-aware roofline
+  equivalence.py — translation validation: ProgramDesc canonicalizer,
+                 structural/abstract/differential equivalence proofs
+                 (PTV022-024), plan equivalence for the partitioner
+                 collapse
 """
 
 from .dataflow import (  # noqa: F401
@@ -41,3 +45,10 @@ from . import contracts  # noqa: F401
 from . import cost  # noqa: F401
 from . import memory  # noqa: F401
 from . import sharding  # noqa: F401
+from . import equivalence  # noqa: F401
+from .equivalence import (  # noqa: F401
+    EquivalenceProof,
+    canonicalize,
+    prove_equivalent,
+    semantic_diff,
+)

@@ -119,6 +119,20 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "a collective inside the inner step spans a DCN mesh axis "
          "('dcn' name prefix, parallel/mesh.py): DCN bandwidth is ~10x "
          "below ICI, so per-step collectives must stay intra-slice"),
+    Rule("PTV022", "transpiler-changed-semantics", ERROR,
+         "translation validation refuted a rewrite: the canonical forms "
+         "differ and either the contract forbids structural drift, a "
+         "fetch's abstract shape/dtype signature moved, or the "
+         "differential oracle confirmed divergence "
+         "(analysis/equivalence.prove_equivalent)"),
+    Rule("PTV023", "duplicate-canonical-subgraph", INFO,
+         "an op recomputes a value an earlier op already produces (same "
+         "type, attrs, and operand value numbers) — a duplicate "
+         "canonical subgraph / missed CSE a pass probably introduced"),
+    Rule("PTV024", "differential-fetch-divergence", ERROR,
+         "concrete differential execution of an original/rewritten "
+         "program pair on identical deterministic feeds produced "
+         "fetch values outside tolerance — a semantics counterexample"),
 ]}
 
 # ops the executor skips (framework/executor.py _NOOP_TYPES) plus desc-only
@@ -553,12 +567,17 @@ def _abstract_seed(block, name, batch_size):
         return _UNKNOWN
 
 
-def _check_shapes(program, block_id, batch_size):
+def abstract_walk(program, block_id=0, batch_size=2):
     """Walk block `block_id` abstractly: each op's emitter runs under
     jax.eval_shape on ShapeDtypeStruct inputs; inferred output shapes are
     compared to declared static shapes.  Any op that cannot be evaluated
     (unknown inputs, host effects, data-dependent lowering) is skipped and
-    poisons its outputs with _UNKNOWN — the rule never guesses."""
+    poisons its outputs with _UNKNOWN — the rule never guesses.
+
+    Returns (env, findings): env maps every value name to its inferred
+    ShapeDtypeStruct (or _UNKNOWN) — the oracle the equivalence engine's
+    abstract tier reads fetch signatures from; findings are the PTV006
+    declared-vs-inferred mismatches."""
     import jax
 
     from ..framework.core import canonical_dtype, np_dtype
@@ -662,7 +681,11 @@ def _check_shapes(program, block_id, batch_size):
                             "PTV006", f"declared dtype {declared} but the "
                             f"registered emitter produces {inferred}",
                             block=block_id, op=i, var=n))
-    return findings
+    return env, findings
+
+
+def _check_shapes(program, block_id, batch_size):
+    return abstract_walk(program, block_id, batch_size)[1]
 
 
 # ---------------------------------------------------------------------------
@@ -728,6 +751,10 @@ def verify_program(program, feed_names: Optional[Iterable[str]] = None,
             findings.extend(_check_sharded_donation(program, donated,
                                                     plan,
                                                     plan_provenance))
+    if want("PTV023"):
+        from .equivalence import duplicate_findings
+
+        findings.extend(duplicate_findings(program, block_id))
     if plan and any(want(r) for r in ("PTV018", "PTV019", "PTV020",
                                       "PTV021")):
         from .sharding import sharding_findings

@@ -21,6 +21,17 @@ Usage: python -m paddle_tpu <subcommand> [args]
                           with no MODEL it analyzes the 11 dryrun
                           parallelism modes and exits 1 on any
                           PTV018/PTV019 finding (the CI gate)
+  diff A [B]            — translation validation (analysis/
+                          equivalence.py): canonicalize both programs
+                          and prove/refute semantic equivalence
+                          (structural → abstract → differential tiers);
+                          human semantic diff or --json; exit 1 when
+                          NOT equivalent.  With one argument: self-check
+                          mode — the program must prove equivalent to
+                          its own canonical form and canonicalization
+                          must be idempotent through a serialize round
+                          trip (the CI fast tier runs this over the
+                          book models)
   show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
   master ...            — fault-tolerant task-dispatch service
@@ -326,6 +337,107 @@ def cmd_analyze(args) -> int:
     return 0
 
 
+def _load_scope_for(path):
+    """Scope of saved values when `path` is a saved-model dir (the
+    persistables.json manifest), else None — the differential oracle
+    then seeds missing state deterministically by name."""
+    if not os.path.isdir(path):
+        return None
+    manifest = os.path.join(path, "persistables.json")
+    if not os.path.exists(manifest):
+        return None
+    from . import io as fluid_io
+    from .framework.scope import Scope
+
+    with open(manifest) as f:
+        names = json.load(f)
+    scope = Scope()
+    fluid_io.load_vars(path, names, scope)  # the one saved-model loader
+    return scope
+
+
+def cmd_diff(args) -> int:
+    from .analysis import equivalence as eqv
+
+    prog_a, feed_a, fetch_a = _load_program_any(args.prog_a)
+    execute = "never" if args.no_exec else "auto"
+
+    if args.prog_b is None:
+        # self-check: prove the program equivalent to its own canonical
+        # form, and canonicalization idempotent through a JSON round
+        # trip.  A bare program dump carries no meta: derive the
+        # interface FIRST, so the canonical form and the proof agree on
+        # it (deriving sinks after canonicalization would chase names
+        # the alpha-renaming already replaced)
+        if fetch_a is None:
+            fetch_a = eqv.sink_outputs(prog_a.global_block())
+        if feed_a is None:
+            feed_a = [v.name for v in prog_a.global_block().vars.values()
+                      if v.is_data]
+        canon, info = eqv.canonicalize(prog_a, fetch_a, feed_a)
+        from .framework.core import Program
+
+        canon_rt = Program.from_json(canon.to_json())
+        canon2, _ = eqv.canonicalize(canon_rt, fetch_a, feed_a)
+        idem = not eqv.semantic_diff(canon, canon2)
+        proof = eqv.prove_equivalent(prog_a, canon, feed_names=feed_a,
+                                     fetch_names=fetch_a,
+                                     batch_size=args.batch_size,
+                                     execute="never")
+        ok = proof.equivalent and idem
+        if args.json:
+            print(json.dumps({
+                "mode": "self_check", "model": args.prog_a,
+                "equivalent": bool(proof.equivalent),
+                "idempotent": bool(idem), "tier": proof.tier,
+                "ops": len(canon.global_block().ops),
+                "dead_removed": info.dead_removed,
+                "renamed": info.renamed,
+                "duplicates": len(info.duplicates)}))
+        else:
+            print(f"self-check {args.prog_a}: "
+                  f"{'OK' if ok else 'FAILED'} "
+                  f"(canonical ops {len(canon.global_block().ops)}, "
+                  f"dead removed {info.dead_removed}, renamed "
+                  f"{info.renamed}, duplicates {len(info.duplicates)}, "
+                  f"idempotent {idem})")
+            if not proof.equivalent:
+                print(proof.render())
+        return 0 if ok else 1
+
+    prog_b, feed_b, fetch_b = _load_program_any(args.prog_b)
+    feed = feed_a if feed_a is not None else feed_b
+    fetch = fetch_a if fetch_a is not None else fetch_b
+    scope_a = _load_scope_for(args.prog_a)
+    scope_b = _load_scope_for(args.prog_b)
+    # one side with values, one bare program (dir vs its program.json):
+    # share the scope — seeding only the bare side with synthetic
+    # weights would fabricate a divergence between identical programs
+    if scope_a is None:
+        scope_a = scope_b
+    elif scope_b is None:
+        scope_b = scope_a
+    if scope_a is not None and not args.no_exec:
+        # saved VALUES are part of a model: two desc-identical dirs with
+        # different weights must diff, so the oracle always runs
+        execute = "always"
+    proof = eqv.prove_equivalent(
+        prog_a, prog_b, feed_names=feed, fetch_names=fetch,
+        batch_size=args.batch_size, scope_before=scope_a,
+        scope_after=scope_b, execute=execute, rtol=args.rtol,
+        atol=args.atol)
+    if args.json:
+        print(json.dumps({
+            "a": args.prog_a, "b": args.prog_b,
+            "equivalent": bool(proof.equivalent), "tier": proof.tier,
+            "findings": [f.format() for f in proof.findings],
+            "diff": proof.diff.render() if proof.diff else None,
+            "detail": proof.detail}))
+    else:
+        print(proof.render())
+    return 0 if proof.equivalent else 1
+
+
 def cmd_show_pb(args) -> int:
     from .utils import show_pb
 
@@ -437,6 +549,26 @@ def main(argv=None) -> int:
                    help="mesh axes for --sharding on a saved model, "
                         "e.g. dp=4,mp=2 (default dp=8)")
     p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser("diff")
+    p.add_argument("prog_a", help="saved model dir, __model__ file, or "
+                                  "program.json")
+    p.add_argument("prog_b", nargs="?", default=None,
+                   help="second program; omit for self-check mode "
+                        "(program vs its own canonical form)")
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="binds -1 feed dims for the abstract and "
+                        "differential tiers")
+    p.add_argument("--no-exec", action="store_true",
+                   help="desc-only: a structural mismatch is final "
+                        "(skip the differential oracle)")
+    p.add_argument("--rtol", type=float, default=1e-4,
+                   help="differential-tier relative tolerance")
+    p.add_argument("--atol", type=float, default=1e-6,
+                   help="differential-tier absolute tolerance")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of the human report")
+    p.set_defaults(fn=cmd_diff)
 
     p = sub.add_parser("merge_model")
     p.add_argument("model_dir")

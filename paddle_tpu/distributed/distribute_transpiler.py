@@ -73,7 +73,10 @@ class DistributeTranspiler:
         PADDLE_TPU_VERIFY=1 the split runs inside its verified-in/
         verified-out contract (analysis/contracts.py): the trainer
         program must still materialize every gradient the pserver round
-        expects."""
+        expects, and since ISSUE 10 must PROVE the gradients mean the
+        same thing — pruned to the grad fetches, trainer and original
+        canonicalize identically (analysis/equivalence.py; a split
+        that changes what a gradient computes is PTV022)."""
         from ..analysis import contracts
 
         if contracts.should_wrap():

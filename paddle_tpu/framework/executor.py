@@ -285,11 +285,19 @@ class Executor:
         return_numpy: bool = True,
         block_id: int = 0,
         verify: Optional[bool] = None,
+        rng_step: Optional[int] = None,
     ):
         """`verify`: run the static program verifier (analysis/verifier.py)
         before execution and raise VerificationError on error findings.
         Default None defers to the PADDLE_TPU_VERIFY=1 env gate; results
-        are cached per program version so steady-state runs pay nothing."""
+        are cached per program version so steady-state runs pay nothing.
+
+        `rng_step`: pin the per-step PRNG fold-in to a fixed step index
+        instead of this executor's monotonic step counter — the
+        translation-validation differential oracle
+        (analysis/equivalence.py) runs an original/rewritten program
+        pair with rng_step=0 so both sides draw the same stochastic
+        stream regardless of executor history."""
         from .core import default_main_program
 
         program = program if program is not None else default_main_program()
@@ -344,7 +352,8 @@ class Executor:
             state_r[n] = self._pin_host_array(scope, n, v)
 
         rng = jax.random.fold_in(
-            jax.random.PRNGKey(program.random_seed), self._step
+            jax.random.PRNGKey(program.random_seed),
+            self._step if rng_step is None else int(rng_step)
         )
         self._step += 1
 

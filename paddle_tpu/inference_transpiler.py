@@ -178,7 +178,12 @@ def fuse_batch_norm(program, scope, block_id: int = 0,
     Pass the vars you will fetch as `fetch_names` — folds that would
     change a fetched conv output's value are skipped.  Under
     PADDLE_TPU_VERIFY=1 the fold runs inside its verified-in/verified-out
-    contract (analysis/contracts.py)."""
+    contract (analysis/contracts.py), which since ISSUE 10 PROVES the
+    fold preserved semantics: the folded program over the folded scope
+    must reproduce the original program's fetches over a pre-fold scope
+    snapshot on deterministic feeds (analysis/equivalence.py
+    differential oracle; divergence beyond the fold's float tolerance
+    is PTV024)."""
     from .analysis import contracts
 
     if contracts.should_wrap():

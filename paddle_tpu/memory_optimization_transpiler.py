@@ -184,9 +184,12 @@ def memory_optimize(program: Program, level: int = 0,
     Under PADDLE_TPU_VERIFY=1 the pass runs inside its verified-in/
     verified-out contract (analysis/contracts.py): program checked before
     and after, the marking must provably not extend any live range
-    (PTV012), and a level-0 marking must provably REDUCE the projected
+    (PTV012), a level-0 marking must provably REDUCE the projected
     peak (PTV017) — `contracts.checked_memory_optimize(report={})`
-    returns the quantified before/after/reduction.  For an
+    returns the quantified before/after/reduction — and the pass must
+    PROVE it changed no semantics (analysis/equivalence.py: the marking
+    may only touch attrs, so the canonical forms must be identical;
+    structural drift is PTV022).  For an
     independently-validated absolute estimate (donation-, shard- and
     workspace-aware, held to ±15% of XLA's buffer assignment) see
     `analysis.memory.peak_estimate`; this module's projection is the

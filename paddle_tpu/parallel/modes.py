@@ -305,6 +305,30 @@ def ensure_virtual_devices(n: int = 8):
     return jax.devices()
 
 
+def logical_plan(mode: ParallelMode, program, mesh):
+    """(partitioner, plan): the LOGICAL-AXIS-RULE declaration of `mode`
+    — the same program sharded by `standard_logical_axis_rules` +
+    `LogicalPartitioner` name inference instead of the mode's bespoke
+    wiring.  The translation-validation engine
+    (analysis/equivalence.mode_plan_equivalence) compares this plan and
+    its propagated collective footprint against `mode_plan`'s: a mode
+    whose two plans agree is PROVEN ready for the ROADMAP #2 collapse;
+    a diverging mode's diff documents exactly which rule is missing
+    from the logical table (e.g. the ZeRO-1/FSDP dim-0 reshard, the
+    column-parallel >=128 width threshold)."""
+    from ..analysis.sharding import (LogicalPartitioner,
+                                     standard_logical_axis_rules)
+    from .mesh import mesh_axis_sizes
+
+    if dict(mode.mesh_axes) != mesh_axis_sizes(mesh):
+        raise ValueError(
+            f"mesh axes {mesh_axis_sizes(mesh)} do not match mode "
+            f"{mode.name!r} ({dict(mode.mesh_axes)}) — a mismatched "
+            f"pair would compare the wrong declaration")
+    lp = LogicalPartitioner(rules=standard_logical_axis_rules())
+    return lp, lp.plan(program, mesh)
+
+
 def mode_plan(mode: ParallelMode, program, devices=None):
     """(mesh, plan, provenance) for one mode: the EFFECTIVE shardings
     its executor would constrain, from descs alone.  Pipeline modes get

@@ -232,7 +232,7 @@ class ParallelExecutor(Executor):
             scope.set(n, jax.device_put(v, target))
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, block_id=0, verify=None):
+            return_numpy=True, block_id=0, verify=None, rng_step=None):
         from ..framework.core import default_main_program
 
         program = program if program is not None else default_main_program()
@@ -247,7 +247,7 @@ class ParallelExecutor(Executor):
         self._distribute_state(
             program, scope, [n for n in names if scope.has(n)])
         return super().run(program, feed, fetch_list, scope, return_numpy,
-                           block_id, verify=verify)
+                           block_id, verify=verify, rng_step=rng_step)
 
     # ------------------------------------------------------------------
     def _compile(self, program, block_id, feed_vals, fetch_names):

@@ -89,7 +89,10 @@ class DistributeTranspiler:
 
         if contracts.should_wrap():
             # verified-in/verified-out (PADDLE_TPU_VERIFY=1): program must
-            # verify, stay unmutated, and every plan key must be declared
+            # verify, stay unmutated (both the version counter AND the
+            # ISSUE-10 canonical-form identity proof — a plan-only pass
+            # that edits descs is PTV022), and every plan key must be
+            # declared
             return contracts.checked_sharding_plan(self, program, mesh)
         from jax.sharding import NamedSharding
 

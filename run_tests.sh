@@ -6,10 +6,12 @@ cd "$(dirname "$0")"
 
 ./build_native.sh
 
-# fast lint tier: repo hygiene + the program verifier AND the static
-# cost/memory analyzer (`paddle_tpu lint` + `paddle_tpu analyze`)
-# end-to-end over two saved book models (docs/analysis.md) — fails in
-# seconds, before pytest
+# fast lint tier: repo hygiene + the program verifier, the static
+# cost/memory analyzer AND the translation-validation self-check
+# (`paddle_tpu lint` + `analyze` + `diff` in self-check mode:
+# program vs itself post-canonicalization, docs/analysis.md ISSUE 10)
+# end-to-end over two saved book models — fails in seconds, before
+# pytest
 python tools/repo_lint.py
 JAX_PLATFORMS=cpu python tools/lint_smoke.py
 

@@ -200,7 +200,7 @@ def test_suppression_per_op_and_per_call():
 
 def test_rule_catalog_stable():
     """IDs are load-bearing (suppressions, CI greps): assert the catalog."""
-    assert [r for r in RULES] == [f"PTV{i:03d}" for i in range(1, 22)]
+    assert [r for r in RULES] == [f"PTV{i:03d}" for i in range(1, 25)]
     assert RULES["PTV001"].severity == "error"
     assert RULES["PTV003"].severity == "warning"
     assert RULES["PTV009"].severity == "warning"
@@ -212,6 +212,9 @@ def test_rule_catalog_stable():
     assert RULES["PTV019"].severity == "warning"
     assert RULES["PTV020"].severity == "info"
     assert RULES["PTV021"].severity == "warning"
+    assert RULES["PTV022"].severity == "error"
+    assert RULES["PTV023"].severity == "info"
+    assert RULES["PTV024"].severity == "error"
 
 
 def test_donated_overwrite_race_ptv015():
@@ -339,6 +342,151 @@ def test_known_crash_parallel_programs_flagged_ptv016():
                   else "ZeRO-1 accumulator reshard")
         assert any(expect in f.message for f in hits), \
             (name, expect, [f.message for f in hits])
+
+        # ISSUE 10: the crash triage also cites the DIVERGING COLLECTIVE
+        # FOOTPRINT — the same ZeRO/FSDP reshard that makes the donated
+        # state sharded (the PTV016 provenance above) is exactly where
+        # the bespoke plan departs from the logical-axis declaration: a
+        # plan-equivalence comparison of the two shows the extra
+        # all-gather traffic the reshard implies (gather-back of
+        # optimizer state / parameter gathers), quantified in bytes.
+        from paddle_tpu.analysis.sharding import (
+            LogicalPartitioner, propagate, spec_of)
+
+        lp = LogicalPartitioner()
+        lplan = lp.plan(prog, pe.mesh)
+        diverging = [v for v in plan
+                     if spec_of(plan[v]) != spec_of(lplan.get(v))
+                     and any(e for e in spec_of(plan[v]))]
+        assert any(v in flagged for v in diverging), (name, diverging)
+        pk_b = propagate(prog, mesh=pe.mesh, plan=plan,
+                         batch_size=8).per_kind()
+        pk_l = propagate(prog, mesh=pe.mesh, plan=lplan,
+                         batch_size=8).per_kind()
+        gather_b = pk_b.get("all-gather", {"bytes": 0})["bytes"]
+        gather_l = pk_l.get("all-gather", {"bytes": 0})["bytes"]
+        assert gather_b > gather_l, \
+            (name, "expected the ZeRO/FSDP reshard to imply extra "
+             "all-gather traffic vs the logical declaration", pk_b, pk_l)
+
+
+# ---------------------------------------------------------------------------
+# translation validation: the PTV022/023/024 mutation spine (ISSUE 10).
+# Each seeded rewrite class is caught with its expected stable rule ID;
+# the deep engine tests live in tests/test_equivalence.py.
+
+
+def test_equivalence_dropped_op_ptv022():
+    """Seeded rewrite: a pass silently drops an op — refuted with
+    PTV022 (the fetch's producer is gone; the differential oracle sees
+    scope garbage where the loss was)."""
+    from paddle_tpu.analysis import prove_equivalent
+    from paddle_tpu.framework.core import Program
+
+    cost, prog = _train_mlp()
+    mut = Program.from_json(prog.to_json())
+    blk = mut.global_block()
+    blk.ops.pop(next(i for i, op in enumerate(blk.ops)
+                     if op.type == "mean"))
+    proof = prove_equivalent(prog, mut, feed_names=["x", "y"],
+                             fetch_names=[cost.name])
+    assert not proof.equivalent
+    assert any(f.rule == "PTV022" for f in proof.findings), proof.render()
+    assert proof.diff and proof.diff.only_in_a  # names the dropped op
+
+
+def test_equivalence_reordered_noncommutative_ptv024():
+    """Seeded rewrite: swapping a NON-commutative op's operands — the
+    canonical forms differ and the differential oracle produces the
+    counterexample (PTV024 with max-error in the message), while the
+    same swap on a commutative add canonicalizes away."""
+    from paddle_tpu.analysis import prove_equivalent
+    from paddle_tpu.framework.core import Program
+
+    cost, prog = _train_mlp()
+    mut = Program.from_json(prog.to_json())
+    sub = next(op for op in mut.global_block().ops
+               if op.type == "elementwise_sub")
+    sub.inputs["X"], sub.inputs["Y"] = sub.inputs["Y"], sub.inputs["X"]
+    proof = prove_equivalent(prog, mut, feed_names=["x", "y"],
+                             fetch_names=[cost.name])
+    # |pred - y| == |y - pred| keeps the LOSS equal; the param UPDATES
+    # flip sign — the written-state comparison is what catches it
+    assert not proof.equivalent
+    hits = [f for f in proof.findings if f.rule == "PTV024"]
+    assert hits, proof.render()
+    assert any("max|a-b|" in f.message for f in hits)
+
+
+def test_equivalence_perturbed_weight_ptv024():
+    """Seeded rewrite: descs untouched, a weight VALUE perturbed (the
+    corrupt-fold bug class) — only the differential tier can see it;
+    execute="always" arms it on a structural match."""
+    from paddle_tpu.analysis import prove_equivalent
+    from paddle_tpu.framework.scope import Scope
+
+    cost, prog = _train_mlp()
+    sa, sb = Scope(), Scope()
+    w = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    sa.set("fc_0.w_0", w)
+    w2 = np.array(w)
+    w2[0, 0] += 0.5
+    sb.set("fc_0.w_0", w2)
+    proof = prove_equivalent(prog, prog, feed_names=["x", "y"],
+                             fetch_names=[cost.name], scope_before=sa,
+                             scope_after=sb, execute="always")
+    assert not proof.equivalent and proof.tier == "differential"
+    assert any(f.rule == "PTV024" for f in proof.findings), proof.render()
+    # same scopes -> validated
+    proof2 = prove_equivalent(prog, prog, feed_names=["x", "y"],
+                              fetch_names=[cost.name], scope_before=sa,
+                              scope_after=sa, execute="always")
+    assert proof2.equivalent
+
+
+def test_equivalence_duplicated_subgraph_ptv023():
+    """Seeded rewrite: duplicating a subgraph (same op, same operand
+    value numbers, fresh output name) — PTV023 info from
+    verify_program's duplicate-canonical-subgraph detector, and from
+    the rewrite proof; renaming-only clones are still caught because
+    detection runs on VALUE NUMBERS, not names."""
+    from paddle_tpu.framework.core import Program
+
+    cost, prog = _train_mlp()
+    blk = prog.global_block()
+    mul_i, mul = next((i, op) for i, op in enumerate(blk.ops)
+                      if op.type == "mul")
+    blk.create_var(name="dup_out", shape=(-1, 8), dtype="float32")
+    blk.append_op("mul",
+                  inputs={k: list(v) for k, v in mul.inputs.items()},
+                  outputs={"Out": ["dup_out"]}, attrs=dict(mul.attrs))
+    # the duplicate feeds something live so dead-op elim keeps it
+    blk.append_op("save", inputs={"X": ["dup_out"]}, outputs={},
+                  attrs={"file_path": "/tmp/never_written",
+                         "overwrite": True})
+    # place the clone BESIDE the original: after the optimizer updates
+    # fc_0.w_0 it would read a different VALUE NUMBER and be a
+    # genuinely different computation (correctly not flagged)
+    save_op = blk.ops.pop()
+    dup_op = blk.ops.pop()
+    blk.ops.insert(mul_i + 1, save_op)
+    blk.ops.insert(mul_i + 1, dup_op)
+    rep = verify_program(prog, feed_names=["x", "y"],
+                         fetch_names=[cost.name], check_shapes=False)
+    hits = [f for f in rep.findings if f.rule == "PTV023"]
+    assert hits and "missed CSE" in hits[0].message, rep.render()
+    assert hits[0].severity == "info"  # advice, not a failure
+
+    # and the proof engine reports it as a rewrite regression
+    from paddle_tpu.analysis import prove_equivalent
+
+    clean = Program.from_json(prog.to_json())
+    b2 = clean.global_block()
+    b2.ops.pop(mul_i + 1)
+    b2.ops.pop(mul_i + 1)
+    proof = prove_equivalent(clean, prog, feed_names=["x", "y"],
+                             fetch_names=[cost.name])
+    assert any(f.rule == "PTV023" for f in proof.findings), proof.render()
 
 
 def test_memory_optimize_quantified_reduction():
@@ -874,6 +1022,32 @@ def test_analyze_cli_on_saved_model(tmp_path, capsys):
 
 # ---------------------------------------------------------------------------
 # repo_lint: CompilerParams rename-shim guard
+
+
+def test_repo_lint_ptv_docs_drift_guard(tmp_path):
+    """Every PTV rule registered in verifier.py needs a docs/analysis.md
+    catalog row, and stale doc rows are flagged too; foreign trees
+    without a verifier are exempt (the synthetic-repo tests above)."""
+    rl = _repo_lint_module()
+    # this repo is currently in sync
+    assert not [f for f in rl.lint(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))) if "PTV" in f]
+
+    v = tmp_path / "paddle_tpu" / "analysis"
+    v.mkdir(parents=True)
+    for d in (tmp_path / "paddle_tpu", v):
+        (d / "__init__.py").write_text("")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (v / "verifier.py").write_text(
+        'RULES = [Rule("PTV001", "a", ERROR, "x"),\n'
+        '         Rule("PTV002", "b", ERROR, "y")]\n')
+    (docs / "analysis.md").write_text(
+        "| PTV001 | a | error | x |\n| PTV099 | ghost | info | z |\n")
+    findings = rl.lint(str(tmp_path))
+    assert any("undocumented verifier rule: PTV002" in f
+               for f in findings), findings
+    assert any("stale rule doc: PTV099" in f for f in findings), findings
 
 
 def test_repo_lint_flags_direct_compiler_params(tmp_path):

@@ -189,7 +189,17 @@ def test_zero_dp_optimizer_state_sharding():
     after a step is the native jax-CPU crash this batch occasionally
     skips with ("native crash in isolation child").  The static analyzer
     flags exactly this shape — see
-    test_analysis.py::test_known_crash_parallel_programs_flagged_ptv016."""
+    test_analysis.py::test_known_crash_parallel_programs_flagged_ptv016.
+
+    PLAN-EQUIVALENCE finding (ISSUE 10, analysis/equivalence.py): the
+    sharding rule behind the hazard — "ZeRO-1 accumulator reshard over
+    'dp' on dim 0" (PR 9 provenance) — is also exactly where this
+    program's bespoke plan DIVERGES from its logical-axis declaration:
+    the reshard implies extra all-gather traffic (the optimizer-state
+    gather-back) the logical table does not, quantified per-kind by the
+    crash-triage half of the test above.  Until the logical table grows
+    a ZeRO state rule, this mode cannot collapse into rule declarations
+    (ROADMAP #2 go/no-go: `tools/hlo_analysis.py equiv`, mode dp_mp)."""
     import jax
     import numpy as np
     import paddle_tpu as fluid
@@ -471,7 +481,15 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     materialization of such arrays is the deterministic native crash
     behind this test's recurring "native crash in isolation child" skip.
     Statically detected: test_analysis.py::
-    test_known_crash_parallel_programs_flagged_ptv016."""
+    test_known_crash_parallel_programs_flagged_ptv016.
+
+    PLAN-EQUIVALENCE finding (ISSUE 10): the hazard's sharding rule
+    ("ZeRO-1 accumulator reshard over 'dp' on dim 0") is the same
+    rule on which the dp×mp bespoke plan diverges from its logical-axis
+    declaration — extra all-gather bytes (state gather-back) the
+    logical table lacks a rule for; see the crash-triage footprint
+    assertions in test_known_crash_parallel_programs_flagged_ptv016 and
+    `tools/hlo_analysis.py equiv` (mode dp_mp, verdict DIVERGED)."""
     from paddle_tpu.distributed import checkpoint as ckpt
 
     def build():
@@ -744,7 +762,15 @@ def test_sharded_checkpoint_roundtrip_fsdp(tmp_path):
     those donated arrays is the native-crash family behind this test's
     recurring "native crash in isolation child" skip.  Statically
     detected: test_analysis.py::
-    test_known_crash_parallel_programs_flagged_ptv016."""
+    test_known_crash_parallel_programs_flagged_ptv016.
+
+    PLAN-EQUIVALENCE finding (ISSUE 10): the hazard's sharding rule
+    ("FSDP/ZeRO-3 parameter shard over 'dp' on dim 0") is where the
+    fsdp bespoke plan diverges from its logical-axis declaration — the
+    forward/backward parameter all-gathers have no logical-table rule
+    yet; see the crash-triage footprint assertions in
+    test_known_crash_parallel_programs_flagged_ptv016 and
+    `tools/hlo_analysis.py equiv` (mode fsdp, verdict DIVERGED)."""
     from paddle_tpu.distributed import checkpoint as ckpt
 
     def build():

@@ -238,6 +238,12 @@ CAPTURES = [
     # trust anchor for the comm-aware roofline's scaling curves
     ("comm_profile",
      [sys.executable, "tools/hlo_analysis.py", "comm"], {}, 1500),
+    # plan equivalence (ISSUE 10): per-mode bespoke-vs-logical-axis
+    # sharding plan + collective-footprint comparison — the ROADMAP #2
+    # go/no-go artifact, refreshed alongside the comm profile so the
+    # partitioner-collapse decision always cites a current sweep
+    ("plan_equivalence",
+     [sys.executable, "tools/hlo_analysis.py", "equiv"], {}, 600),
     ("unet",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "unet", "BENCH_ITERS": "10"}, 580),

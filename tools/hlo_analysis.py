@@ -36,6 +36,17 @@ Usage:
                                          # catalog + the lm_dp/lm_mp/
                                          # lm_fsdp acceptance trio); one
                                          # static-vs-actual JSON line each
+  python tools/hlo_analysis.py equiv [--mode NAME]
+                                         # plan-equivalence sweep
+                                         # (analysis/equivalence.py): each
+                                         # dryrun parallelism mode's
+                                         # bespoke plan + propagated
+                                         # collective footprint vs its
+                                         # logical-axis-rule declaration —
+                                         # the ROADMAP #2 go/no-go
+                                         # artifact; one JSON line per
+                                         # mode, desc-only (nothing
+                                         # compiles)
   python tools/hlo_analysis.py all   # bytes+collectives, JSON per line
 
 The workload runs in a re-exec'd child with XLA_FLAGS=--xla_dump_to so
@@ -679,6 +690,27 @@ def analyze(mode: str, args) -> dict:
     return rec
 
 
+def run_equiv(args) -> None:
+    """The 11-mode plan-equivalence sweep: bespoke wiring vs logical-
+    axis declaration, one JSON line per mode plus a summary line.
+    Desc-only (virtual devices, nothing compiles) — safe to run in the
+    evidence daemon's queue without a live chip."""
+    from paddle_tpu.analysis import equivalence as eqv
+    from paddle_tpu.parallel import modes as pmodes
+
+    pmodes.ensure_virtual_devices(8)
+    names = [args.submode] if args.submode else list(pmodes.MODE_NAMES)
+    proven = 0
+    for name in names:
+        rec = eqv.mode_plan_equivalence(name)
+        rec["analysis"] = "plan_equivalence"
+        proven += rec["verdict"] == "PROVEN"
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"analysis": "plan_equivalence_summary",
+                      "modes": len(names), "proven": proven,
+                      "diverged": len(names) - proven}), flush=True)
+
+
 def analyze_roofline(args) -> None:
     """Driver half of the roofline capture: run the child (accelerator-
     honoring, like bytes mode), pass its JSON line through."""
@@ -694,7 +726,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
                     choices=["bytes", "collectives", "peak", "roofline",
-                             "comm", "all"])
+                             "comm", "equiv", "all"])
     ap.add_argument("--child", default=None)
     ap.add_argument("--mode", dest="submode", default=None)
     ap.add_argument("--bs", type=int, default=32)
@@ -728,6 +760,9 @@ def main():
         return
     if args.what == "comm":
         run_comm(args)
+        return
+    if args.what == "equiv":
+        run_equiv(args)
         return
     if args.what in ("bytes", "all"):
         for fuse in ((False, True) if args.what == "all"

@@ -1,13 +1,16 @@
-"""Fast CI lint tier: build + save two book models, lint AND analyze
-the saved dirs.
+"""Fast CI lint tier: build + save two book models, lint, analyze, AND
+translation-validate the saved dirs.
 
 Exercises the full `paddle_tpu lint` path end-to-end (save_inference_model
 -> proto_io/program.json load -> verifier report) on fit-a-line and
 recognize-digits, the two canonical book programs, then runs
-`paddle_tpu analyze` (static cost & memory analyzer) over the same dirs
-so a cost-model/estimator regression also fails in seconds.  Exit 0 iff
-both models lint clean and analyze successfully.  Runs on CPU; wired
-into run_tests.sh before the pytest tiers.
+`paddle_tpu analyze` (static cost & memory analyzer) and
+`paddle_tpu diff` in SELF-CHECK mode (analysis/equivalence.py: the
+saved program must prove equivalent to its own canonical form and
+canonicalization must be idempotent) over the same dirs, so a
+cost-model/estimator/canonicalizer regression also fails in seconds.
+Exit 0 iff both models pass all three.  Runs on CPU; wired into
+run_tests.sh before the pytest tiers.
 """
 
 from __future__ import annotations
@@ -76,8 +79,14 @@ def main() -> int:
                 print(f"lint_smoke: analyze {name} FAILED (rc={r})",
                       file=sys.stderr)
             rc = rc or r
+            print(f"== paddle_tpu diff {name} (self-check)")
+            r = cli.main(["diff", d])
+            if r:
+                print(f"lint_smoke: diff self-check {name} FAILED "
+                      f"(rc={r})", file=sys.stderr)
+            rc = rc or r
     if not rc:
-        print("lint_smoke: OK (2 models, lint + analyze)")
+        print("lint_smoke: OK (2 models, lint + analyze + diff)")
     return rc
 
 

@@ -18,6 +18,11 @@ Three classes of rot this repo has actually accumulated:
      (analysis/sharding.py) can trust every plan it is handed; an
      ad-hoc spec tuple in a mode file is exactly the bespoke wiring the
      logical-axis refactor (ROADMAP #2) is collapsing.
+  5. PTV rule/doc drift — every ``Rule("PTVnnn", ...)`` registered in
+     ``paddle_tpu/analysis/verifier.py`` must have a ``| PTVnnn |`` row
+     in the ``docs/analysis.md`` rule catalog (PTV001–024 were drifting
+     apart by hand), and the docs must not carry rows for rules the
+     verifier no longer registers.
 
 Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
 any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
@@ -96,6 +101,41 @@ def _check_partition_spec(root, dirpath, filenames, findings):
             pass
 
 
+# the PTV rule/doc drift guard: rule registrations in verifier.py vs
+# catalog rows in docs/analysis.md
+_RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
+_RULE_ROW_RE = re.compile(r"^\|\s*(PTV\d{3})\s*\|", re.MULTILINE)
+_VERIFIER_PATH = os.path.join("paddle_tpu", "analysis", "verifier.py")
+_RULE_DOC_PATH = os.path.join("docs", "analysis.md")
+
+
+def _check_ptv_docs(root, findings):
+    vpath = os.path.join(root, _VERIFIER_PATH)
+    dpath = os.path.join(root, _RULE_DOC_PATH)
+    if not os.path.exists(vpath):
+        return  # foreign tree (the synthetic-repo tests): no verifier,
+        # nothing to drift
+    try:
+        with open(vpath, encoding="utf-8") as f:
+            registered = set(_RULE_DEF_RE.findall(f.read()))
+        with open(dpath, encoding="utf-8") as f:
+            documented = set(_RULE_ROW_RE.findall(f.read()))
+    except OSError as e:
+        # verifier present but the docs unreadable IS drift
+        findings.append(f"PTV rule catalog unreadable: {e}")
+        return
+    for rid in sorted(registered - documented):
+        findings.append(
+            f"undocumented verifier rule: {rid} is registered in "
+            f"{_VERIFIER_PATH} but has no catalog row in "
+            f"{_RULE_DOC_PATH}")
+    for rid in sorted(documented - registered):
+        findings.append(
+            f"stale rule doc: {rid} has a catalog row in "
+            f"{_RULE_DOC_PATH} but is not registered in "
+            f"{_VERIFIER_PATH}")
+
+
 def _source_for(pyc_name: str) -> str:
     """foo.cpython-310.pyc -> foo.py (also plain foo.pyc)."""
     base = pyc_name.split(".")[0]
@@ -105,6 +145,7 @@ def _source_for(pyc_name: str) -> str:
 def lint(root: str):
     findings = []
     root = os.path.abspath(root)
+    _check_ptv_docs(root, findings)
     for dirpath, dirnames, filenames in os.walk(root):
         rel = os.path.relpath(dirpath, root)
         parts = [] if rel == "." else rel.split(os.sep)
