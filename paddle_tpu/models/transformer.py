@@ -275,6 +275,51 @@ class DecoderLM:
                    "eps": 1e-5})
         return tok
 
+    def prefill_chunk(self, tokens, ctx_len, chunk_len, page_table, cache,
+                      page_size):
+        """Append one chunked-prefill op (ops/attention_ops.py
+        paged_prefill_chunk): materialize K/V for `tokens` [K,C,1] at
+        context offset `ctx_len` [K,1] through `page_table`, return the
+        argmax token [K] at each lane's last valid position (meaningful
+        only on a lane's FINAL chunk; `chunk_len` [K,1] = 0 idles a
+        lane).  The v2 engine's prefill quantum — interleaved with
+        decode inside one mixed program."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        kpool, vpool = cache
+        helper = LayerHelper("paged_prefill_chunk")
+        tok = helper.create_tmp_variable("int64", shape=(-1,),
+                                         stop_gradient=True)
+        ins = self._decode_inputs(tokens)
+        ins.update({"CtxLen": [ctx_len.name], "ChunkLen": [chunk_len.name],
+                    "PageTable": [page_table.name],
+                    "KPool": [kpool.name], "VPool": [vpool.name]})
+        helper.append_op(
+            "paged_prefill_chunk", inputs=ins,
+            outputs={"NextToken": [tok.name], "KPoolOut": [kpool.name],
+                     "VPoolOut": [vpool.name]},
+            attrs={"n_heads": self.n_heads, "page_size": int(page_size),
+                   "eps": 1e-5})
+        return tok
+
+    def page_copy(self, src, dst, cache):
+        """Append a paged_page_copy op: physical page `src` [M,1] ->
+        `dst` [M,1] across every layer of both pools (prefix-cache
+        copy-on-write; unused lanes pass 0 -> 0, a null-page no-op).
+        Returns the fetchable dst witness [M] int64."""
+        kpool, vpool = cache
+        helper = LayerHelper("paged_page_copy")
+        out = helper.create_tmp_variable("int64", shape=(-1,),
+                                         stop_gradient=True)
+        helper.append_op(
+            "paged_page_copy",
+            inputs={"Src": [src.name], "Dst": [dst.name],
+                    "KPool": [kpool.name], "VPool": [vpool.name]},
+            outputs={"Out": [out.name], "KPoolOut": [kpool.name],
+                     "VPoolOut": [vpool.name]},
+            attrs={})
+        return out
+
     def _decode_inputs(self, prompt):
         """Wire the recorded tower parameters into a decode op's slots,
         declaring them in the current program (see generate())."""

@@ -344,6 +344,121 @@ def paged_decode_step(ctx, ins, attrs):
             "VPoolOut": [hold["v"]]}
 
 
+@register_op("paged_prefill_chunk", grad=None,
+             non_diff_inputs=("Tokens", "CtxLen", "ChunkLen", "PageTable"))
+def paged_prefill_chunk(ctx, ins, attrs):
+    """CHUNKED prefill: one fixed-size slice of a prompt, at a context
+    offset, into the paged KV pools — the v2 serving engine's prefill
+    quantum (ISSUE 11).  Unlike paged_prefill (whole prompt from
+    position 0), this op continues a partially materialized context:
+    positions [ctx, ctx+chunk) are embedded, written through the page
+    table, and attend over the WHOLE paged context so far (prefix-cache
+    hits + earlier chunks + this chunk causally).
+
+    Inputs: Tokens [K,C,1] int64 (chunk tokens, 0-padded), CtxLen [K,1]
+    (positions already materialized — via earlier chunks OR shared
+    prefix-cache pages), ChunkLen [K,1] (valid tokens this chunk; 0 =
+    idle lane, all writes land in the null page), PageTable [K,maxp],
+    KPool/VPool, plus the gpt_decode parameter slots.  Attrs: n_heads,
+    page_size, eps.  Outputs: NextToken [K] int64 (argmax at each lane's
+    LAST valid chunk position — the first generated token when this
+    chunk completes the prompt, garbage otherwise; idle lanes emit 0),
+    KPoolOut/VPoolOut.
+
+    paged_decode_step is exactly this op at C=1 — kept separate so the
+    steady-state decode program never pays chunk-width compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from .transformer_ops import _lm_fns, _prompt_2d
+
+    nh = int(attrs["n_heads"])
+    ps = int(attrs["page_size"])
+    eps = float(attrs.get("eps", 1e-5))
+
+    tokens = _prompt_2d(ins)  # [K,C] int32
+    ctx0 = _squeeze_feed(ins["CtxLen"][0], jnp.int32)
+    clen = _squeeze_feed(ins["ChunkLen"][0], jnp.int32)
+    pt = ins["PageTable"][0].astype(jnp.int32)  # [K,maxp]
+    kpool, vpool = ins["KPool"][0], ins["VPool"][0]
+
+    fns = _lm_fns(ins, nh, eps)
+    emb = ins["Emb"][0]
+    cdt = emb.dtype
+    scale = 1.0 / (fns.dh ** 0.5)
+    K, C = tokens.shape
+    maxp = pt.shape[1]
+
+    i_idx = jnp.arange(C, dtype=jnp.int32)
+    pos = ctx0[:, None] + i_idx[None, :]              # [K,C] absolute
+    valid = i_idx[None, :] < clen[:, None]
+    # pad/idle writes land in the null page; the pos-table gather clamps
+    # so a pad tail running past max_len stays in range
+    blk = jnp.minimum(pos // ps, maxp - 1)
+    page = jnp.where(valid, jnp.take_along_axis(pt, blk, axis=1), 0)
+    off = pos % ps
+    pos_c = jnp.minimum(pos, fns.pos.shape[0] - 1)
+
+    x = emb[tokens] + jnp.take(fns.pos, pos_c, axis=0).astype(cdt)  # [K,C,D]
+
+    hold = {"k": kpool, "v": vpool}
+    pages_f, offs_f = page.reshape(-1), off.reshape(-1)
+    kpos = jnp.arange(maxp * ps)
+
+    def attend(i, q, k, v):
+        rows = lambda a: a.transpose(0, 2, 1, 3).reshape(K * C, nh, fns.dh)
+        hold["k"] = _paged_pools_write(hold["k"], i, pages_f, offs_f,
+                                       rows(k))
+        hold["v"] = _paged_pools_write(hold["v"], i, pages_f, offs_f,
+                                       rows(v))
+        # dense gather over the slot's whole paged window (the
+        # paged_attention_ref idiom: f32 scores, -1e30 mask) — cached
+        # prefix, earlier chunks, and this chunk attend uniformly, with
+        # causality enforced by key-position <= query-position
+        dense = lambda pool: pool[i][pt].transpose(0, 2, 1, 3, 4).reshape(
+            K, nh, maxp * ps, fns.dh)
+        kd, vd = dense(hold["k"]), dense(hold["v"])
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kd).astype(
+            jnp.float32) * scale
+        s = jnp.where(kpos[None, None, None, :] <= pos[:, None, :, None],
+                      s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vd.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vd)
+
+    for i in range(fns.L):
+        x = fns.block(i, x, attend)
+
+    last = jnp.take_along_axis(
+        x, jnp.maximum(clen - 1, 0).astype(jnp.int32)[:, None, None],
+        axis=1)  # [K,1,D]
+    nxt = jnp.argmax(fns.head_logits(last), axis=-1).astype(jnp.int32)
+    nxt = jnp.where(clen > 0, nxt, 0).astype(jnp.int64)
+    return {"NextToken": [nxt], "KPoolOut": [hold["k"]],
+            "VPoolOut": [hold["v"]]}
+
+
+@register_op("paged_page_copy", grad=None, non_diff_inputs=("Src", "Dst"))
+def paged_page_copy(ctx, ins, attrs):
+    """Device-side page copy for prefix-cache COPY-ON-WRITE: duplicate
+    physical page Src into Dst across every layer of both pools, so a
+    request diverging inside a shared block gets a private page carrying
+    the shared prefix's K/V without recomputing it.
+
+    Inputs: Src/Dst [M,1] int64 page ids (M is a static batch of copies;
+    unused lanes pass src=dst=0 — copying the null page onto itself is a
+    no-op by construction), KPool/VPool.  Outputs: Out [M] int64 (the
+    dst ids, a fetchable witness), KPoolOut/VPoolOut."""
+    import jax.numpy as jnp
+
+    src = _squeeze_feed(ins["Src"][0], jnp.int32)
+    dst = _squeeze_feed(ins["Dst"][0], jnp.int32)
+    kpool, vpool = ins["KPool"][0], ins["VPool"][0]
+    kpool = kpool.at[:, dst].set(kpool[:, src])
+    vpool = vpool.at[:, dst].set(vpool[:, src])
+    return {"Out": [dst.astype(jnp.int64)], "KPoolOut": [kpool],
+            "VPoolOut": [vpool]}
+
+
 @register_op("attention_gru_cell", grad=None, non_diff_inputs=("EncLength",
                                                                "Tokens"))
 def attention_gru_cell(ctx, ins, attrs):
@@ -519,6 +634,47 @@ def _paged_prefill_cost(ins, outs, attrs):
 
 
 register_cost("paged_prefill", _paged_prefill_cost)
+
+
+def _paged_prefill_chunk_cost(ins, outs, attrs):
+    """Chunk forward: tower matmuls (24*K*C*D^2 per layer) + attention of
+    C queries against the page-table window (4*K*H*C*max_ctx*dh per
+    layer) + head logits on the last position."""
+    tokens = ins.get("Tokens", [None])[0]  # [K, C, 1]
+    emb = ins.get("Emb", [None])[0]
+    kpool = ins.get("KPool", [None])[0]
+    pt = ins.get("PageTable", [None])[0]
+    if tokens is None or emb is None or kpool is None \
+            or len(kpool.shape) != 5:
+        return {}
+    k = tokens.shape[0] if len(tokens.shape) >= 1 else 1
+    c = tokens.shape[1] if len(tokens.shape) >= 2 else 1
+    vocab, d = emb.shape
+    n_layers, _, n_heads, page, dh = kpool.shape
+    max_ctx = (pt.shape[1] * page if pt is not None
+               and len(pt.shape) == 2 else page)
+    per_layer = 24 * k * c * d * d + 4 * k * n_heads * c * max_ctx * dh
+    return {"flops": n_layers * per_layer + 2 * k * d * vocab}
+
+
+register_cost("paged_prefill_chunk", _paged_prefill_chunk_cost)
+
+
+def _paged_page_copy_cost(ins, outs, attrs):
+    """Pure data movement: M pages × both pools × every layer, read +
+    write.  FLOPs ~0; the bytes override keeps the roofline honest."""
+    kpool = ins.get("KPool", [None])[0]
+    src = ins.get("Src", [None])[0]
+    if kpool is None or len(kpool.shape) != 5 or src is None:
+        return {}
+    m = src.shape[0] if len(src.shape) >= 1 else 1
+    n_layers, _, n_heads, page, dh = kpool.shape
+    from ..analysis.memory import dtype_bytes
+    page_bytes = n_layers * n_heads * page * dh * dtype_bytes(kpool.dtype)
+    return {"flops": 0, "bytes": 2 * 2 * m * page_bytes}
+
+
+register_cost("paged_page_copy", _paged_page_copy_cost)
 
 
 # ---------------------------------------------------------------------------

@@ -4,18 +4,25 @@ The training stack compiles whole programs (framework/executor.py); this
 package composes it into a long-lived *service* in the TensorFlow-paper
 sense — a shared device, a request queue, and an engine loop:
 
-  kv_cache.py  — fixed page pool + per-slot page tables (the allocator;
-                 page 0 is the reserved null page)
-  scheduler.py — FIFO continuous batching: admit requests into free
-                 decode slots, evict finished ones, free their pages
-  engine.py    — ServingEngine: builds the paged prefill/decode programs
+  kv_cache.py  — fixed page pool (refcounted pages; page 0 is the
+                 reserved null page), per-slot page tables, and the
+                 hash-keyed prefix-cache index for cross-request page
+                 sharing
+  scheduler.py — two continuous-batching schedulers: strict-FIFO with
+                 worst-case reservation (v1 baseline) and the
+                 priority/deadline-aware watermark scheduler with
+                 preemption (v2)
+  engine.py    — ServingEngine: builds the paged prefill/decode (and v2
+                 mixed chunked-prefill+decode / COW page-copy) programs
                  over a DecoderLM and runs one Executor step per engine
                  iteration
 
-Benchmarked by tools/serve_bench.py; documented in docs/serving.md.
+Benchmarked by tools/serve_bench.py (--scheduler {fifo,v2,ab});
+documented in docs/serving.md.
 """
 
 from .engine import ServingEngine  # noqa: F401
 from .kv_cache import (PageAllocator, PagedKVCache,  # noqa: F401
-                       page_size_from_env, pages_needed)
-from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
+                       PrefixCache, page_size_from_env, pages_needed)
+from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
+                        PreemptiveScheduler, Request)

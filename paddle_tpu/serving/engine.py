@@ -9,21 +9,38 @@ One engine owns:
   * a paged KV cache (kv_cache.py) whose pools live in the scope as
     persistable state, donated in and out of each step's executable —
     the cache never leaves HBM;
-  * PREFILL programs, one per prompt-length bucket (next power of two),
-    compiled lazily on first use and cached by the Executor thereafter;
-  * a ContinuousBatchingScheduler deciding, between steps, which waiting
-    requests take freed slots and which finished ones release pages.
+  * a scheduler deciding, between steps, which waiting requests take
+    freed slots and which finished ones release pages.
 
-The engine iteration (`step()`):
+Two scheduler modes (ISSUE 11):
+
+``scheduler="fifo"`` — the v1 baseline.  Whole-prompt PREFILL programs,
+one per prompt-length bucket (next power of two), compiled lazily;
+worst-case page reservation; strict-FIFO admission.  The engine
+iteration (`step()`):
   1. admit: scheduler moves queue-head requests into free slots; each is
      prefilled (bucket-padded, ragged lengths fine) and its first token
      recorded;
   2. decode: one paged_decode_step over all slots; active slots append
      their token, requests hitting eos/max_new are evicted.
 
+``scheduler="v2"`` — prefix caching + chunked prefill + preemption.
+Prompts prefill in fixed-size CHUNKS through a single static-shape MIXED
+program (decode over all slots + `chunk_lanes` chunk lanes in ONE
+executable), so long prompts never stall the running batch's decode and
+TTFT/steady-state tok/s stop trading off.  Admission consults the
+prefix-cache index: shared full blocks are mapped (refcounted) instead
+of recomputed, a partially matching block is copied on device
+(copy-on-write) before its first divergent token, and pages for decode
+are allocated on demand — under pressure the scheduler evicts-and-
+requeues the lowest-priority request, whose resume (re-prefill of
+prompt + generated-so-far) reproduces the uninterrupted greedy output
+token-for-token.
+
 Everything on-device is deterministic greedy argmax, so the engine's
-output must exactly reproduce the full-prefix tower oracle — that is the
-serving correctness contract tests/test_serving.py enforces.
+output must exactly reproduce the full-prefix tower oracle in BOTH
+modes — that is the serving correctness contract tests/test_serving.py
+enforces.
 """
 
 from __future__ import annotations
@@ -34,7 +51,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .kv_cache import PagedKVCache, page_size_from_env, pages_needed
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import (RUNNING, ContinuousBatchingScheduler,
+                        PreemptiveScheduler, Request)
 
 
 def _bucket_of(n: int, lo: int = 8) -> int:
@@ -50,12 +68,24 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  eos_id: int = -1,
                  max_prefill_per_step: int = 4,
-                 place=None, clock=time.monotonic):
+                 place=None, clock=time.monotonic,
+                 scheduler: str = "fifo",
+                 chunk_size: Optional[int] = None,
+                 chunk_lanes: Optional[int] = None,
+                 watermark_pages: Optional[int] = None,
+                 prefix_caching: bool = True):
         """`lm` is a DecoderLM whose tower is already built (.logits())
         and whose parameters are initialized in the global scope (the
         startup program ran).  `num_pages` defaults to enough for every
         slot at max_len simultaneously (+ the null page); pass something
-        smaller to actually exercise queueing under page pressure."""
+        smaller to actually exercise queueing under page pressure.
+
+        v2 knobs: `chunk_size` tokens per prefill chunk (default 32),
+        `chunk_lanes` concurrent chunks per mixed step (default
+        max_prefill_per_step), `watermark_pages` free pages admission
+        keeps for decode growth (default: sized from hbm_report() — the
+        worst transient program peak expressed in pages),
+        `prefix_caching=False` disables the shared-page index."""
         from .. import layers
         from ..framework import unique_name
         from ..framework.core import Program, np_dtype, program_guard
@@ -66,7 +96,10 @@ class ServingEngine:
         if lm._params is None:
             raise RuntimeError("build the model tower with .logits() "
                                "before constructing a ServingEngine")
+        if scheduler not in ("fifo", "v2"):
+            raise ValueError(f"scheduler={scheduler!r}: use 'fifo' or 'v2'")
         self.lm = lm
+        self.mode = scheduler
         self.eos_id = int(eos_id)
         self.num_slots = int(max_batch_size)
         self.page_size = int(page_size if page_size is not None
@@ -79,8 +112,6 @@ class ServingEngine:
 
         self.cache = PagedKVCache(self.num_slots, self.max_pages,
                                   self.num_pages, self.page_size)
-        self.scheduler = ContinuousBatchingScheduler(
-            self.cache, max_prefill_per_step=max_prefill_per_step)
 
         self._exe = Executor(place if place is not None else default_place())
         self._pfx = unique_name.generate("serve")
@@ -99,6 +130,16 @@ class ServingEngine:
             self._decode_fetch = lm.decode_step(
                 cache_vars, tok, ctx, act, pt, self.page_size)
 
+        self._mixed_prog = None
+        self._copy_prog = None
+        if self.mode == "v2":
+            self.chunk_size = int(chunk_size if chunk_size is not None
+                                  else min(32, lm.max_len))
+            self.chunk_lanes = int(chunk_lanes if chunk_lanes is not None
+                                   else max(1, min(max_prefill_per_step,
+                                                   self.num_slots)))
+            self._build_v2_programs()
+
         # the pools themselves: zero-initialized persistable scope state
         # (page 0 = null page); device_put + donation keep them in HBM
         dh = lm.dim // lm.n_heads
@@ -109,23 +150,103 @@ class ServingEngine:
         self._scope.set(f"{self._cache_name}.v", np.zeros(pool_shape, dt))
 
         self._prefill_progs: Dict[int, tuple] = {}  # bucket -> (prog, fetch)
+        if self.mode == "v2":
+            if watermark_pages is None:
+                watermark_pages = self._default_watermark()
+            self.scheduler = PreemptiveScheduler(
+                self.cache, max_prefill_per_step=max_prefill_per_step,
+                watermark_pages=watermark_pages,
+                prefix_caching=prefix_caching)
+        else:
+            self.scheduler = ContinuousBatchingScheduler(
+                self.cache, max_prefill_per_step=max_prefill_per_step)
         self.finished: Dict[int, Request] = {}
         self._steps = 0
+        # serving counters (bench + tests): prefill tokens actually
+        # computed vs served from the prefix cache, COW copies run, and
+        # the peak stranded-reservation gauge the v1 path exposes
+        self.counters = {"prefill_computed": 0, "prefill_cached": 0,
+                         "cow_copies": 0, "peak_stranded": 0,
+                         "mixed_steps": 0, "decode_steps": 0}
+
+    # ------------------------------------------------------------------
+    def _build_v2_programs(self):
+        from .. import layers
+        from ..framework.core import Program, program_guard
+
+        lm, mp = self.lm, self.max_pages
+        # ONE mixed prefill+decode program: a decode step over every slot
+        # plus `chunk_lanes` prefill chunks, one executable per engine
+        # step — a prefilling prompt and the running batch's decode share
+        # the invocation instead of queueing behind each other
+        self._mixed_prog = Program()
+        with program_guard(self._mixed_prog):
+            tok = layers.data(f"{self._pfx}.m.tok", shape=[1],
+                              dtype="int64")
+            ctx = layers.data(f"{self._pfx}.m.ctx", shape=[1],
+                              dtype="int64")
+            act = layers.data(f"{self._pfx}.m.act", shape=[1],
+                              dtype="int64")
+            pt = layers.data(f"{self._pfx}.m.pt", shape=[mp],
+                             dtype="int64")
+            ctok = layers.data(f"{self._pfx}.m.ctok",
+                               shape=[self.chunk_size, 1], dtype="int64")
+            cctx = layers.data(f"{self._pfx}.m.cctx", shape=[1],
+                               dtype="int64")
+            cclen = layers.data(f"{self._pfx}.m.cclen", shape=[1],
+                                dtype="int64")
+            cpt = layers.data(f"{self._pfx}.m.cpt", shape=[mp],
+                              dtype="int64")
+            cache_vars = lm.declare_kv_cache(self.num_pages, self.page_size,
+                                             name=self._cache_name)
+            self._mixed_decode_fetch = lm.decode_step(
+                cache_vars, tok, ctx, act, pt, self.page_size)
+            self._mixed_chunk_fetch = lm.prefill_chunk(
+                ctok, cctx, cclen, cpt, cache_vars, self.page_size)
+
+        # COW page-copy program (prefix cache, one copy per run — copies
+        # are per-admission rare, so a bigger static batch buys nothing)
+        self._copy_prog = Program()
+        with program_guard(self._copy_prog):
+            src = layers.data(f"{self._pfx}.cp.src", shape=[1],
+                              dtype="int64")
+            dst = layers.data(f"{self._pfx}.cp.dst", shape=[1],
+                              dtype="int64")
+            cache_vars = lm.declare_kv_cache(self.num_pages, self.page_size,
+                                             name=self._cache_name)
+            self._copy_fetch = lm.page_copy(src, dst, cache_vars)
+
+    def _default_watermark(self) -> int:
+        """Admission headroom, sized from the static HBM report: the
+        worst transient program peak on top of the pools, expressed in
+        pages — the growth buffer that keeps a full batch's in-flight
+        decode from hitting an empty free list the step after a greedy
+        admission.  Clamped to a quarter of the pool so tiny test pools
+        stay admittable."""
+        rep = self.hbm_report()
+        page_bytes = max(1, rep["kv_pool_bytes"] // self.num_pages)
+        transient = max(rep["program_peak_bytes"].values() or [0])
+        wm = -(-transient // page_bytes)
+        return int(max(1, min(wm, max(1, (self.num_pages - 1) // 4))))
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
-               arrival: Optional[float] = None) -> int:
+               arrival: Optional[float] = None, priority: int = 0,
+               deadline: Optional[float] = None) -> int:
         """Queue one request; returns its id (see .finished after run()).
         `arrival` (engine-clock timestamp) defaults to now — an open-loop
         load generator passes the SCHEDULED arrival instead, so queueing
         delay spent blocked behind an in-flight step still counts in the
-        reported latency."""
+        reported latency.  `priority` orders v2 admission AND preemption
+        survival; `deadline` only breaks admission ties between equal
+        priorities.  The FIFO scheduler ignores both."""
         if len(prompt) + int(max_new_tokens) > self.lm.max_len:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
                 f"exceeds model max_len={self.lm.max_len}")
         req = Request(prompt, max_new_tokens,
-                      arrival=self._clock() if arrival is None else arrival)
+                      arrival=self._clock() if arrival is None else arrival,
+                      priority=priority, deadline=deadline)
         self.scheduler.submit(req)
         return req.rid
 
@@ -201,6 +322,7 @@ class ServingEngine:
             for i, r in enumerate(group):
                 r.ctx_len = len(r.prompt)
                 r.first_token_t = now
+                self.counters["prefill_computed"] += len(r.prompt)
                 self._record_token(r, int(np.asarray(first)[i]), now)
 
     def _record_token(self, req: Request, token: int, now: float):
@@ -236,15 +358,142 @@ class ServingEngine:
             self._record_token(r, int(nxt[slot]), now)
 
     # ------------------------------------------------------------------
-    def step(self) -> bool:
-        """One engine iteration (admit+prefill, then one decode step for
-        every occupied slot); returns True while work remains."""
-        admitted = self.scheduler.admit(now=self._clock())
-        if admitted:
-            self._prefill(admitted)
-        self._decode()
+    # v2: mixed chunked-prefill + decode step, COW copies, preemption
+
+    def _run_copies(self):
+        """Drain the scheduler's pending COW copies (one tiny program run
+        each) BEFORE any chunk writes into the destination pages.  The
+        scheduler pinned each source page at admission (so reclaim could
+        not recycle it out from under the pending copy); the pin is
+        released here, once the content is duplicated."""
+        for slot, src, dst in self.scheduler.pending_copies:
+            self._exe.run(
+                self._copy_prog,
+                feed={f"{self._pfx}.cp.src": np.array([[src]], np.int64),
+                      f"{self._pfx}.cp.dst": np.array([[dst]], np.int64)},
+                fetch_list=[self._copy_fetch])
+            self.cache.allocator.free([src])
+            self.counters["cow_copies"] += 1
+        self.scheduler.pending_copies.clear()
+
+    def _index_prompt(self, req: Request):
+        """Prefill just completed: publish the request's whole prompt
+        blocks (immutable from here on — decode writes land at positions
+        >= len(prompt)) into the prefix index for later requests."""
+        if not self.scheduler.prefix_caching:
+            return
+        nb = len(req.prompt) // self.page_size
+        if nb:
+            self.cache.prefix.insert(req.prompt, req.pages[:nb], nb)
+
+    def _step_v2(self) -> bool:
+        now = self._clock()
+        self.scheduler.admit(now=now)
+        self._run_copies()
+
+        # on-demand decode growth BEFORE feeds are built: a slot about to
+        # write position ctx_len needs block ctx_len // ps mapped; under
+        # pressure grow() may preempt (possibly the grower itself), so
+        # re-check liveness as the walk goes
+        for r in sorted(self.scheduler.active.values(),
+                        key=lambda r: (-r.priority, r.arrival, r.rid)):
+            if r.state != RUNNING or r.ctx_len < r.prefill_target:
+                continue
+            if r.ctx_len // self.page_size >= len(r.pages):
+                self.scheduler.grow(r, now=now)
+
+        lanes = [r for r in self.scheduler.active.values()
+                 if r.ctx_len < r.prefill_target]
+        lanes.sort(key=lambda r: (-r.priority, r.admit_t, r.rid))
+        lanes = lanes[:self.chunk_lanes]
+        decoding = [(slot, r) for slot, r in self.scheduler.active.items()
+                    if r.ctx_len >= r.prefill_target]
+
+        if not lanes and not decoding:
+            self._steps += 1
+            return self.scheduler.outstanding() > 0
+
+        if not lanes:
+            # steady state: the plain decode program, chunk-width free
+            self._decode()
+            self.counters["decode_steps"] += 1
+            self._steps += 1
+            return self.scheduler.outstanding() > 0
+
+        N, K, C = self.num_slots, self.chunk_lanes, self.chunk_size
+        tok = np.zeros((N, 1), np.int64)
+        ctx = np.zeros((N, 1), np.int64)
+        act = np.zeros((N, 1), np.int64)
+        for slot, r in decoding:
+            tok[slot, 0] = r.generated[-1]
+            ctx[slot, 0] = r.ctx_len
+            act[slot, 0] = 1
+        ctok = np.zeros((K, C, 1), np.int64)
+        cctx = np.zeros((K, 1), np.int64)
+        cclen = np.zeros((K, 1), np.int64)
+        cpt = np.zeros((K, self.max_pages), np.int64)
+        chunk_of: List[tuple] = []
+        for j, r in enumerate(lanes):
+            prefix = r.prompt + r.generated
+            cl = min(C, r.prefill_target - r.ctx_len)
+            ctok[j, :cl, 0] = prefix[r.ctx_len:r.ctx_len + cl]
+            cctx[j, 0] = r.ctx_len
+            cclen[j, 0] = cl
+            cpt[j] = self.cache.page_table[r.slot]
+            chunk_of.append((r, cl))
+        (nxt, cnxt) = self._exe.run(
+            self._mixed_prog,
+            feed={f"{self._pfx}.m.tok": tok, f"{self._pfx}.m.ctx": ctx,
+                  f"{self._pfx}.m.act": act,
+                  f"{self._pfx}.m.pt": self.cache.page_table_i64(),
+                  f"{self._pfx}.m.ctok": ctok, f"{self._pfx}.m.cctx": cctx,
+                  f"{self._pfx}.m.cclen": cclen,
+                  f"{self._pfx}.m.cpt": cpt},
+            fetch_list=[self._mixed_decode_fetch, self._mixed_chunk_fetch])
+        nxt, cnxt = np.asarray(nxt), np.asarray(cnxt)
+        now = self._clock()
+        self.counters["mixed_steps"] += 1
+        for j, (r, cl) in enumerate(chunk_of):
+            r.ctx_len += cl
+            r.computed_prefill_tokens += cl
+            self.counters["prefill_computed"] += cl
+            if r.ctx_len >= r.prefill_target:
+                # prefill complete: the lane's token is the next greedy
+                # token after prompt+generated (the FIRST token for a
+                # fresh request, the resume continuation otherwise)
+                if r.first_token_t is None:
+                    r.first_token_t = now
+                self.counters["prefill_cached"] += r.cached_prefill_tokens
+                r.cached_prefill_tokens = 0
+                self._index_prompt(r)
+                self._record_token(r, int(cnxt[j]), now)
+        for slot, r in decoding:
+            if r.state != RUNNING:
+                continue  # finished by the chunk walk? impossible, but
+                # the snapshot idiom stays cheap insurance
+            r.ctx_len += 1
+            self._record_token(r, int(nxt[slot]), now)
         self._steps += 1
         return self.scheduler.outstanding() > 0
+
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration; returns True while work remains.  FIFO:
+        admit + whole-prompt prefill, then one decode step.  v2: admit
+        (+ COW copies), then ONE mixed chunked-prefill/decode program."""
+        if self.mode == "v2":
+            alive = self._step_v2()
+        else:
+            admitted = self.scheduler.admit(now=self._clock())
+            if admitted:
+                self._prefill(admitted)
+            self._decode()
+            self._steps += 1
+            alive = self.scheduler.outstanding() > 0
+        stats = self.scheduler.page_stats()
+        if stats["stranded"] > self.counters["peak_stranded"]:
+            self.counters["peak_stranded"] = stats["stranded"]
+        return alive
 
     def run(self, max_steps: int = 100000) -> Dict[int, Request]:
         """Drive until every submitted request finished (or the step
@@ -264,11 +513,24 @@ class ServingEngine:
         self.finished = {}
         return out
 
+    def stats(self) -> dict:
+        """Serving counters + allocator/prefix/scheduler stats in one
+        dict (the bench artifact's per-scheduler row)."""
+        out = dict(self.counters)
+        out["page_stats"] = self.scheduler.page_stats()
+        out["prefix"] = self.cache.prefix.stats()
+        out["preemptions"] = getattr(self.scheduler, "preemptions", 0)
+        return out
+
     # ------------------------------------------------------------------
     def programs(self) -> Dict[str, object]:
         """The engine-built programs, for linting/inspection (the CI
         smoke runs `python -m paddle_tpu lint` over these)."""
         out = {"decode": self._decode_prog}
+        if self._mixed_prog is not None:
+            out["mixed"] = self._mixed_prog
+        if self._copy_prog is not None:
+            out["page_copy"] = self._copy_prog
         for b, (prog, _) in sorted(self._prefill_progs.items()):
             out[f"prefill_{b}"] = prog
         return out
@@ -278,7 +540,8 @@ class ServingEngine:
         the resident K/V pools plus the peak of every engine-built
         program at its compiled batch shape.  `total_peak_bytes` is the
         worst program peak ON TOP of the pools — the number to compare
-        against a chip's HBM before sizing num_pages/max_batch_size."""
+        against a chip's HBM before sizing num_pages/max_batch_size (and
+        the v2 watermark)."""
         from ..analysis import memory as amem
         from ..framework.core import np_dtype
 

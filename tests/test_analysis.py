@@ -740,6 +740,40 @@ def test_repo_lint_catches_orphans(tmp_path):
     assert any("dead package dir" in f for f in findings)
 
 
+def test_repo_lint_page_table_mutation_guard(tmp_path):
+    """Writes through `.page_table[...]` anywhere under paddle_tpu/
+    outside serving/kv_cache.py are findings (they desync the cached
+    feed view and the refcount accounting); reads and the allocator
+    module itself are exempt (ISSUE 11)."""
+    rl = _repo_lint_module()
+
+    serving = tmp_path / "paddle_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "__init__.py").write_text("")
+    (serving / "__init__.py").write_text("")
+    # the allocator module may mutate; a read elsewhere is fine
+    (serving / "kv_cache.py").write_text(
+        "self.page_table[slot, :] = 0\n")
+    (serving / "engine.py").write_text(
+        "row = self.cache.page_table[r.slot]\n")
+    assert rl.lint(str(tmp_path)) == []
+    # raw writes (plain, augmented, nested-subscript index) outside
+    # kv_cache.py are findings
+    (serving / "engine.py").write_text(
+        "self.cache.page_table[slot, 0] = page\n"
+        "self.cache.page_table[slot] += 1\n"
+        "self.cache.page_table[idx[0], blocks[j]] = page\n")
+    findings = [f for f in rl.lint(str(tmp_path))
+                if "page-table mutation" in f]
+    assert len(findings) == 3 and "engine.py:1" in findings[0]
+    # outside the paddle_tpu tree (e.g. tests poking fixtures): exempt
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "x.py").write_text(
+        "cache.page_table[0, 0] = 3\n")
+    assert not any("tools" in f for f in rl.lint(str(tmp_path))
+                   if "page-table" in f)
+
+
 # ---------------------------------------------------------------------------
 # static cost model (analysis/cost.py)
 

@@ -189,6 +189,13 @@ CAPTURES = [
      [sys.executable, "tools/serve_bench.py"],
      {"SERVE_SLOTS": "64", "SERVE_REQUESTS": "96", "SERVE_SWEEP": "1,8"},
      580),
+    # serving v2 A/B (ISSUE 11): fifo vs the prefix-caching/chunked-
+    # prefill/preemptive scheduler at identical Poisson load + the
+    # prefix-heavy workload, with the token-identity cross-check — the
+    # first on-chip p99/tok-per-s comparison row and cache-hit fraction
+    ("serve_v2",
+     [sys.executable, "tools/serve_bench.py", "--scheduler", "ab"],
+     {"SERVE_SLOTS": "64", "SERVE_REQUESTS": "96"}, 900),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},

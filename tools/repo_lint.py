@@ -18,7 +18,14 @@ Three classes of rot this repo has actually accumulated:
      (analysis/sharding.py) can trust every plan it is handed; an
      ad-hoc spec tuple in a mode file is exactly the bespoke wiring the
      logical-axis refactor (ROADMAP #2) is collapsing.
-  5. PTV rule/doc drift — every ``Rule("PTVnnn", ...)`` registered in
+  5. page-table mutation outside the allocator API — the serving
+     page table (``PagedKVCache.page_table``) caches an int64 feed view
+     and backs the allocator's refcount accounting; a raw
+     ``x.page_table[...] = ...`` anywhere in ``paddle_tpu/`` outside
+     ``serving/kv_cache.py`` silently desyncs both (stale device feeds,
+     leaked prefix-cache refcounts).  Mutate through ``assign`` /
+     ``map_block`` / ``release`` only; reads are fine.
+  6. PTV rule/doc drift — every ``Rule("PTVnnn", ...)`` registered in
      ``paddle_tpu/analysis/verifier.py`` must have a ``| PTVnnn |`` row
      in the ``docs/analysis.md`` rule catalog (PTV001–024 were drifting
      apart by hand), and the docs must not carry rows for rules the
@@ -101,6 +108,50 @@ def _check_partition_spec(root, dirpath, filenames, findings):
             pass
 
 
+# the page-table mutation guard: assignment (plain or augmented) through
+# a `.page_table[...]` subscript anywhere under paddle_tpu/ outside the
+# allocator module — reads don't match (the `=` must follow the `]`).
+# Each subscript may itself contain one bracket level (`[idx[0], b]`),
+# so the pattern balances a single nesting depth instead of stopping at
+# the first `]`, and chained subscripts (`[slot][0] = p`) match too.
+# KNOWN LIMIT: the check is per physical line and name-anchored — an
+# alias (`pt = cache.page_table; pt[s] = p`) or a write wrapped across
+# lines slips through; it is a reviewer's tripwire against the easy
+# mistake, not an AST-grade proof.  Keep writes on one line and never
+# alias the table outside kv_cache.py.
+_PAGE_TABLE_RE = re.compile(
+    r"\.page_table\s*(?:\[[^\[\]]*(?:\[[^\]]*\][^\[\]]*)*\]\s*)+"
+    r"(?:[+\-*/%&|^]|//|>>|<<)?=(?!=)")
+_PAGE_TABLE_DIR = "paddle_tpu"
+_PAGE_TABLE_OK = os.path.join("paddle_tpu", "serving", "kv_cache.py")
+
+
+def _check_page_table(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    if not (rel_dir == _PAGE_TABLE_DIR
+            or rel_dir.startswith(_PAGE_TABLE_DIR + os.sep)):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel == _PAGE_TABLE_OK:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _PAGE_TABLE_RE.search(line):
+                        findings.append(
+                            f"page-table mutation outside the allocator "
+                            f"API: {rel}:{i} (go through PagedKVCache."
+                            f"assign/map_block/release in serving/"
+                            f"kv_cache.py — raw writes desync the cached "
+                            f"feed view and the refcount accounting)")
+        except OSError:
+            pass
+
+
 # the PTV rule/doc drift guard: rule registrations in verifier.py vs
 # catalog rows in docs/analysis.md
 _RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
@@ -172,6 +223,7 @@ def lint(root: str):
             continue
         _check_compiler_params(root, dirpath, filenames, findings)
         _check_partition_spec(root, dirpath, filenames, findings)
+        _check_page_table(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)

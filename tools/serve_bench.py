@@ -1,15 +1,31 @@
 #!/usr/bin/env python
-"""Continuous-batching serving load generator (ROADMAP item #1's number).
+"""Continuous-batching serving load generator + scheduler A/B harness.
 
 Drives paddle_tpu.serving.ServingEngine over a DecoderLM with synthetic
 Poisson traffic — mixed prompt lengths, open-loop arrivals — and prints
-ONE JSON line in the bench.py artifact schema: headline
-{"metric","value","unit","vs_baseline"} = sustained decode tokens/sec at
-the largest batch, request/TTFT latency percentiles under
-"percentiles" and as "extra_metrics" rows (render_results.py renders
-both).  The evidence daemon queues this script for the next live TPU
-window; on CPU it is the tier-1 proof that the serving loop sustains
->= 64 requests at bs up to 64.
+ONE JSON line in the bench.py artifact schema.
+
+Three modes (`--scheduler`):
+
+  fifo   the PR 7 baseline engine (worst-case page reservation, strict
+         FIFO, whole-prompt prefill) — the original artifact, unchanged;
+  v2     the ISSUE 11 engine (prefix caching, chunked prefill, watermark
+         admission with preemption);
+  ab     BOTH, over the same request spec AND a prefix-heavy workload
+         (shared system prompt, Zipf-distributed suffixes), with a
+         token-identity cross-check on every completed request — the
+         comparison artifact the evidence daemon queues as `serve_v2`.
+         Headline = v2 standard-workload tokens/s; `vs_baseline` = its
+         gain over fifo at the SAME load and pool.
+
+In ab/v2 modes (or with SERVE_POOL_FRAC set explicitly) both engines run
+against the same deliberately undersized page pool (SERVE_POOL_FRAC x
+the worst case) so admission policy actually matters: the fifo engine's
+worst-case reservation strands pages (reported via `peak_stranded`), the
+v2 engine packs more concurrent requests into the same pool.  Standalone
+`--scheduler fifo` with no explicit SERVE_POOL_FRAC keeps the engine's
+worst-case default pool — the PR 7 capture config, so the longitudinal
+`serve_decode_tok_per_s_*` series stays comparable.
 
 Env knobs (bench.py idiom):
   SERVE_SLOTS=64        decode slots (max batch)
@@ -18,14 +34,17 @@ Env knobs (bench.py idiom):
   SERVE_MAX_NEW=32      tokens generated per request
   SERVE_PROMPT_MIN/MAX  mixed prompt lengths, log-uniform (default 8/96)
   SERVE_DIM/LAYERS/HEADS/VOCAB  model config (default 128/2/4/512)
-  SERVE_SWEEP           extra slot counts to also run, e.g. "1,8"
-                        (each adds an extra_metrics tokens/s row)
+  SERVE_POOL_FRAC=0.55  page pool as a fraction of worst-case demand
+  SERVE_CHUNK=32        v2 prefill chunk size (tokens)
+  SERVE_SWEEP           extra slot counts to also run (fifo/v2 modes
+                        only), e.g. "1,8"
   PADDLE_TPU_PAGE_SIZE  KV page size (serving/kv_cache.py)
 
 Flags:
+  --scheduler {fifo,v2,ab}   default fifo
   --smoke               tiny config (8 requests, 4 slots, dim 32) with
                         hard correctness asserts — the run_tests.sh fast
-                        tier entry
+                        tier entry (use with --scheduler ab)
   --save-programs DIR   write the engine-built programs as program JSON
                         for `python -m paddle_tpu lint`
   --out FILE            also write the artifact JSON to FILE
@@ -51,20 +70,51 @@ def _env_int(name, default):
         return default
 
 
-def build_engine(slots, dim, n_layers, n_heads, vocab, max_len, seed=0):
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def pool_pages(slots, cfg):
+    """Shared A/B pool: SERVE_POOL_FRAC of the all-slots worst case, but
+    never below one worst-case request (+ the null page) so the fifo
+    submit-time feasibility check keeps passing.  ``pool_frac=None``
+    (the longitudinal standalone-fifo capture) defers to the engine's
+    own worst-case default."""
+    from paddle_tpu.serving import page_size_from_env, pages_needed
+
+    if cfg["pool_frac"] is None:
+        return None
+    ps = page_size_from_env()
+    worst_req = pages_needed(cfg["pmax"] + cfg["max_new"], ps)
+    worst_all = slots * worst_req
+    return 1 + max(worst_req + 1,
+                   int(round(cfg["pool_frac"] * worst_all)))
+
+
+def build_engine(slots, cfg, scheduler="fifo", seed=0):
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
     from paddle_tpu.serving import ServingEngine
 
-    lm = transformer.DecoderLM(vocab, dim, n_layers, n_heads,
-                               max_len=max_len, dtype="float32")
-    tokens = fluid.layers.data("tokens", shape=[max_len, 1], dtype="int64")
+    lm = transformer.DecoderLM(cfg["vocab"], cfg["dim"], cfg["layers"],
+                               cfg["heads"], max_len=cfg["max_len"],
+                               dtype="float32")
+    tokens = fluid.layers.data("tokens", shape=[cfg["max_len"], 1],
+                               dtype="int64")
     lm.logits(tokens, is_test=True)
     fluid.default_main_program().random_seed = seed
     exe = fluid.Executor(fluid.default_place())
     exe.run(fluid.default_startup_program())
+    kw = {}
+    if scheduler == "v2":
+        kw["chunk_size"] = min(cfg["chunk"], cfg["max_len"])
     return lm, ServingEngine(lm, max_batch_size=slots,
-                             place=fluid.default_place())
+                             num_pages=pool_pages(slots, cfg),
+                             scheduler=scheduler,
+                             place=fluid.default_place(), **kw)
 
 
 def synth_requests(n, rate, pmin, pmax, max_new, vocab, seed=0):
@@ -81,13 +131,42 @@ def synth_requests(n, rate, pmin, pmax, max_new, vocab, seed=0):
     return out
 
 
+def synth_prefix_requests(n, rate, pmin, pmax, max_new, vocab, seed=0,
+                          n_templates=8, zipf_a=1.1):
+    """Prefix-heavy traffic: every prompt = one shared SYSTEM PROMPT
+    (~60% of pmax) + a suffix drawn from a small template pool with
+    Zipf-ish popularity — the system-prompt-plus-canned-task shape the
+    prefix cache is built for.  Repeated templates mean repeated WHOLE
+    prompts too, exercising the full-hit copy-on-write path."""
+    rng = np.random.RandomState(seed + 7919)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    # cap so system prompt + the mandatory >=1-token suffix stays within
+    # pmax (pmin >= pmax, e.g. fixed-length SERVE_PROMPT_MIN=MAX runs,
+    # would otherwise build pmax+1-token prompts and fail submit())
+    sys_len = min(max(pmin, int(round(pmax * 0.6))), max(pmax - 1, 0))
+    sys_prompt = rng.randint(0, vocab, size=sys_len).tolist()
+    smax = max(1, pmax - sys_len)
+    templates = [rng.randint(0, vocab,
+                             size=rng.randint(1, smax + 1)).tolist()
+                 for _ in range(n_templates)]
+    w = 1.0 / np.power(np.arange(1, n_templates + 1), zipf_a)
+    w /= w.sum()
+    out = []
+    for i in range(n):
+        t = templates[rng.choice(n_templates, p=w)]
+        out.append((float(arrivals[i]), sys_prompt + t, max_new))
+    return out
+
+
 def run_load(engine, spec):
     """Open-loop load: submit each request when the wall clock passes its
     arrival stamp, stepping the engine continuously in between.  Returns
-    (finished, elapsed_s): elapsed covers first submit -> last finish."""
+    (rids_in_submission_order, elapsed_s): elapsed covers first submit ->
+    last finish."""
     from collections import deque
 
     pending = deque(spec)
+    rids = []
     t0 = time.monotonic()
     while pending or engine.outstanding():
         now = time.monotonic() - t0
@@ -96,45 +175,80 @@ def run_load(engine, spec):
             # stamp the SCHEDULED arrival: time spent blocked behind an
             # in-flight engine step is queueing delay the percentiles
             # must count, not silently drop
-            engine.submit(prompt, max_new, arrival=t0 + due)
+            rids.append(engine.submit(prompt, max_new, arrival=t0 + due))
         if engine.outstanding():
             engine.step()
         elif pending:
             time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
-    return engine.finished, time.monotonic() - t0
+    return rids, time.monotonic() - t0
 
 
 def percentile_ms(vals, q):
     return round(float(np.percentile(np.asarray(vals) * 1000.0, q)), 2)
 
 
-def measure(slots, cfg, seed=0):
-    import paddle_tpu as fluid
+def _warm(engine, spec, scheduler):
+    """Warm every executable the load will hit, then wipe the run state
+    (finished map, prefix index, counters) so the measured window is
+    clean.  fifo compiles one prefill program per prompt bucket; v2's
+    mixed/decode programs are shape-static, but the COW copy program
+    needs one identical-prompt pair to trigger."""
     from paddle_tpu.serving.engine import _bucket_of
 
-    fluid.reset()
-    lm, engine = build_engine(slots, cfg["dim"], cfg["layers"],
-                              cfg["heads"], cfg["vocab"], cfg["max_len"],
-                              seed=seed)
-    spec = synth_requests(cfg["requests"], cfg["rate"], cfg["pmin"],
-                          cfg["pmax"], cfg["max_new"], cfg["vocab"],
-                          seed=seed)
-    # warm the executables (decode + EVERY prompt bucket the load will
-    # hit) so compile time doesn't pollute the sustained-throughput window
-    seen = set()
-    for _, prompt, _ in spec:
-        b = _bucket_of(len(prompt))
-        if b not in seen:
-            seen.add(b)
-            engine.submit(prompt, 2)
-    engine.run()
+    if scheduler == "fifo":
+        seen = set()
+        for _, prompt, _ in spec:
+            b = _bucket_of(len(prompt))
+            if b not in seen:
+                seen.add(b)
+                engine.submit(prompt, 2)
+        engine.run()
+    else:
+        rng = np.random.RandomState(12345)
+        # EXACTLY two whole pages: the identical resubmit then shares
+        # block 0 and copy-on-writes block 1 (reuse cap = len-1 leaves
+        # page_size-1 >= the min-COW threshold), compiling the copy
+        # program outside the measured window.  A non-aligned tail
+        # would leave its block unindexed and COW would never trigger.
+        blocks = max(1, min(2, (engine.lm.max_len - 2)
+                            // engine.page_size))
+        warm = rng.randint(0, engine.lm.vocab_size,
+                           size=blocks * engine.page_size).tolist()
+        engine.submit(warm, 2)
+        engine.run()
+        engine.submit(warm, 2)  # identical resubmit -> COW copy program
+        engine.run()
+        assert blocks < 2 or engine.counters["cow_copies"] > 0, \
+            "warm-up failed to compile the COW copy program"
+        engine.cache.prefix.clear()
     engine.finished.clear()
+    for k in engine.counters:
+        engine.counters[k] = 0
+    engine._steps = 0  # rows report measured-window steps only
 
-    finished, elapsed = run_load(engine, spec)
+
+def measure(slots, cfg, scheduler="fifo", workload="standard", seed=0):
+    import paddle_tpu as fluid
+
+    fluid.reset()
+    lm, engine = build_engine(slots, cfg, scheduler=scheduler, seed=seed)
+    synth = (synth_prefix_requests if workload == "prefix"
+             else synth_requests)
+    spec = synth(cfg["requests"], cfg["rate"], cfg["pmin"], cfg["pmax"],
+                 cfg["max_new"], cfg["vocab"], seed=seed)
+    _warm(engine, spec, scheduler)
+
+    rids, elapsed = run_load(engine, spec)
+    finished = engine.finished
     toks = sum(len(r.generated) for r in finished.values())
     lat = [r.finish_t - r.arrival for r in finished.values()]
     ttft = [r.first_token_t - r.arrival for r in finished.values()]
-    return engine, {
+    st = engine.stats()
+    computed = st["prefill_computed"]
+    cached = st["prefill_cached"]
+    row = {
+        "scheduler": scheduler,
+        "workload": workload,
         "slots": slots,
         "requests": len(finished),
         "tokens": toks,
@@ -145,18 +259,133 @@ def measure(slots, cfg, seed=0):
         "ttft_p50_ms": percentile_ms(ttft, 50),
         "ttft_p99_ms": percentile_ms(ttft, 99),
         "steps": engine._steps,
+        "num_pages": engine.num_pages,
+        "prefill_tokens_computed": computed,
+        "prefill_tokens_cached": cached,
+        "prefill_cache_frac": round(cached / max(computed + cached, 1), 4),
+        "peak_stranded_pages": st["peak_stranded"],
+        "preemptions": st["preemptions"],
+        "cow_copies": st["cow_copies"],
     }
+    # generated streams by SUBMISSION order: the cross-scheduler
+    # token-identity check keys on this, not on engine-global rids
+    outputs = [finished[rid].generated if rid in finished else None
+               for rid in rids]
+    return engine, row, outputs
 
 
-def save_programs(engine, outdir):
+def save_programs(engine, outdir, prefix=""):
     os.makedirs(outdir, exist_ok=True)
     paths = []
     for name, prog in engine.programs().items():
-        p = os.path.join(outdir, f"{name}.json")
+        p = os.path.join(outdir, f"{prefix}{name}.json")
         with open(p, "w") as f:
             f.write(prog.to_json())
         paths.append(p)
     return paths
+
+
+def _leak_check(engine):
+    """Every page is either free or held by the prefix index; clearing
+    the index must return the pool to full."""
+    avail = engine.cache.allocator.available()
+    reclaim = engine.cache.prefix.reclaimable()
+    full = engine.num_pages - 1
+    assert avail + reclaim == full, (avail, reclaim, full)
+    engine.cache.prefix.clear()
+    assert engine.cache.allocator.available() == full, "page leak"
+
+
+def _ab_artifact(cfg, slots, results, matches):
+    """results[(workload, scheduler)] = row; matches[workload] = bool."""
+    std_v2 = results[("standard", "v2")]
+    std_fifo = results[("standard", "fifo")]
+    pfx_v2 = results[("prefix", "v2")]
+    gain = std_v2["tok_per_s"] / max(std_fifo["tok_per_s"], 1e-9) - 1.0
+    extra = []
+    for (wl, sched), r in sorted(results.items()):
+        extra.append({"metric": f"serve_{sched}_{wl}_tok_per_s_bs{slots}",
+                      "value": r["tok_per_s"], "unit": "tokens/sec",
+                      "percentiles": {"p50_ms": r["lat_p50_ms"],
+                                      "p99_ms": r["lat_p99_ms"],
+                                      "ttft_p50_ms": r["ttft_p50_ms"],
+                                      "ttft_p99_ms": r["ttft_p99_ms"]}})
+    extra.append({"metric": f"serve_v2_prefix_cache_frac_bs{slots}",
+                  "value": pfx_v2["prefill_cache_frac"], "unit": "frac"})
+    extra.append({"metric": f"serve_fifo_peak_stranded_pages_bs{slots}",
+                  "value": std_fifo["peak_stranded_pages"],
+                  "unit": "pages"})
+    comparison = {}
+    for (wl, sched), r in results.items():
+        comparison.setdefault(wl, {})[sched] = r
+    return {
+        "metric": f"serve_v2_decode_tok_per_s_bs{slots}",
+        "value": std_v2["tok_per_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(gain, 4),
+        "note": (f"scheduler A/B at identical Poisson load "
+                 f"(rate {cfg['rate']}/s, {cfg['requests']} reqs, pool "
+                 f"{std_v2['num_pages']} pages = "
+                 f"{cfg['pool_frac']:.2f}x worst case): v2 "
+                 f"{std_v2['tok_per_s']} tok/s p99 "
+                 f"{std_v2['lat_p99_ms']}ms vs fifo "
+                 f"{std_fifo['tok_per_s']} tok/s p99 "
+                 f"{std_fifo['lat_p99_ms']}ms; prefix-heavy row serves "
+                 f"{pfx_v2['prefill_cache_frac']:.0%} of prefill tokens "
+                 f"from cache; baseline = fifo row of this artifact"),
+        "percentiles": {"p50_ms": std_v2["lat_p50_ms"],
+                        "p99_ms": std_v2["lat_p99_ms"],
+                        "ttft_p50_ms": std_v2["ttft_p50_ms"],
+                        "ttft_p99_ms": std_v2["ttft_p99_ms"]},
+        "outputs_match": all(matches.values()),
+        "outputs_match_by_workload": matches,
+        "comparison": comparison,
+        "extra_metrics": extra,
+    }
+
+
+def _single_artifact(cfg, rows, scheduler):
+    head = rows[0]
+    extra = [
+        {"metric": f"serve_req_latency_p50_ms_bs{head['slots']}",
+         "value": head["lat_p50_ms"], "unit": "ms"},
+        {"metric": f"serve_req_latency_p99_ms_bs{head['slots']}",
+         "value": head["lat_p99_ms"], "unit": "ms"},
+        {"metric": f"serve_ttft_p50_ms_bs{head['slots']}",
+         "value": head["ttft_p50_ms"], "unit": "ms"},
+        {"metric": f"serve_ttft_p99_ms_bs{head['slots']}",
+         "value": head["ttft_p99_ms"], "unit": "ms"},
+    ]
+    # standalone v2 gets its own `_solo` series: the ab artifact's
+    # headline already owns serve_v2_decode_tok_per_s_* (real
+    # vs_baseline, comparison/outputs_match fields) and a longitudinal
+    # consumer keyed on metric name must never mix the two
+    tag = "" if scheduler == "fifo" else f"_{scheduler}_solo"
+    extra += [
+        {"metric": f"serve{tag}_decode_tok_per_s_bs{r['slots']}",
+         "value": r["tok_per_s"], "unit": "tokens/sec",
+         "percentiles": {"p50_ms": r["lat_p50_ms"],
+                         "p99_ms": r["lat_p99_ms"]}}
+        for r in rows[1:]
+    ]
+    return {
+        "metric": f"serve{tag}_decode_tok_per_s_bs{head['slots']}",
+        "value": head["tok_per_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "note": (f"continuous batching ({scheduler}): "
+                 f"{head['requests']} reqs, "
+                 f"{head['tokens']} tokens in {head['elapsed_s']}s over "
+                 f"{head['steps']} engine steps "
+                 f"(d{cfg['dim']} l{cfg['layers']} "
+                 f"prompts {cfg['pmin']}-{cfg['pmax']}, Poisson "
+                 f"rate {cfg['rate']}/s); no anchor row exists"),
+        "percentiles": {"p50_ms": head["lat_p50_ms"],
+                        "p99_ms": head["lat_p99_ms"],
+                        "ttft_p50_ms": head["ttft_p50_ms"],
+                        "ttft_p99_ms": head["ttft_p99_ms"]},
+        "extra_metrics": extra,
+    }
 
 
 def main(argv=None):
@@ -169,6 +398,8 @@ def main(argv=None):
     warnings.filterwarnings(
         "ignore", message=".*requested in astype is not available.*")
     ap = argparse.ArgumentParser()
+    ap.add_argument("--scheduler", choices=["fifo", "v2", "ab"],
+                    default="fifo")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--save-programs", metavar="DIR")
     ap.add_argument("--out", metavar="FILE")
@@ -176,7 +407,8 @@ def main(argv=None):
 
     if args.smoke:
         cfg = dict(dim=32, layers=2, heads=2, vocab=64, max_len=128,
-                   requests=8, rate=200.0, pmin=3, pmax=24, max_new=6)
+                   requests=8, rate=200.0, pmin=3, pmax=24, max_new=6,
+                   pool_frac=0.75, chunk=8)
         slot_list = [4]
     else:
         cfg = dict(dim=_env_int("SERVE_DIM", 128),
@@ -184,64 +416,72 @@ def main(argv=None):
                    heads=_env_int("SERVE_HEADS", 4),
                    vocab=_env_int("SERVE_VOCAB", 512),
                    requests=_env_int("SERVE_REQUESTS", 96),
-                   rate=float(os.environ.get("SERVE_RATE", "32")),
+                   rate=_env_float("SERVE_RATE", 32.0),
                    pmin=_env_int("SERVE_PROMPT_MIN", 8),
                    pmax=_env_int("SERVE_PROMPT_MAX", 96),
-                   max_new=_env_int("SERVE_MAX_NEW", 32))
+                   max_new=_env_int("SERVE_MAX_NEW", 32),
+                   pool_frac=_env_float("SERVE_POOL_FRAC", 0.55),
+                   chunk=_env_int("SERVE_CHUNK", 32))
         cfg["max_len"] = cfg["pmax"] + cfg["max_new"]
+        if args.scheduler == "fifo" and "SERVE_POOL_FRAC" not in os.environ:
+            # the PR 7 longitudinal capture: standalone fifo keeps the
+            # engine-default worst-case pool so serve_decode_tok_per_s_*
+            # stays comparable across PRs; ab/v2 (or an explicit
+            # SERVE_POOL_FRAC) run the constrained pool where admission
+            # policy actually matters
+            cfg["pool_frac"] = None
         slot_list = [_env_int("SERVE_SLOTS", 64)]
-        sweep = os.environ.get("SERVE_SWEEP", "")
-        slot_list += [int(s) for s in sweep.split(",") if s.strip()]
+        if args.scheduler != "ab":
+            sweep = os.environ.get("SERVE_SWEEP", "")
+            slot_list += [int(s) for s in sweep.split(",") if s.strip()]
 
-    rows = []
     engine = None
-    for slots in slot_list:
-        engine, row = measure(slots, cfg)
-        rows.append(row)
+    if args.scheduler == "ab":
+        slots = slot_list[0]
+        results, matches = {}, {}
+        for workload in ("standard", "prefix"):
+            outs = {}
+            for sched in ("fifo", "v2"):
+                engine, row, outputs = measure(slots, cfg, scheduler=sched,
+                                               workload=workload)
+                results[(workload, sched)] = row
+                outs[sched] = outputs
+                if args.smoke:
+                    assert row["requests"] == cfg["requests"], row
+                    _leak_check(engine)
+                if args.save_programs:
+                    # v2 programs under their own names, fifo's (incl.
+                    # the bucketed whole-prompt prefills — still the
+                    # production baseline) prefixed: BOTH engines stay
+                    # under the CI `paddle_tpu lint` gate
+                    save_programs(engine, args.save_programs,
+                                  prefix="" if sched == "v2" else "fifo_")
+            # the acceptance contract: greedy outputs token-identical on
+            # every completed request, fifo vs v2, same submission index
+            pairs = list(zip(outs["fifo"], outs["v2"]))
+            ok = all(a is not None and a == b for a, b in pairs)
+            matches[workload] = ok
+            if args.smoke:
+                assert ok, f"{workload}: v2 tokens diverge from fifo"
         if args.smoke:
-            # hard correctness gates for the CI tier
-            assert row["requests"] == cfg["requests"], row
-            for r in engine.finished.values():
-                assert 1 <= len(r.generated) <= cfg["max_new"], r.rid
-            assert engine.cache.allocator.available() == \
-                engine.num_pages - 1, "page leak"
-        if args.save_programs and engine is not None:
-            save_programs(engine, args.save_programs)
+            assert results[("prefix", "v2")]["prefill_cache_frac"] >= 0.3, \
+                results[("prefix", "v2")]
+        artifact = _ab_artifact(cfg, slots, results, matches)
+    else:
+        rows = []
+        for slots in slot_list:
+            engine, row, _ = measure(slots, cfg, scheduler=args.scheduler)
+            rows.append(row)
+            if args.smoke:
+                # hard correctness gates for the CI tier
+                assert row["requests"] == cfg["requests"], row
+                for r in engine.finished.values():
+                    assert 1 <= len(r.generated) <= cfg["max_new"], r.rid
+                _leak_check(engine)
+            if args.save_programs and engine is not None:
+                save_programs(engine, args.save_programs)
+        artifact = _single_artifact(cfg, rows, args.scheduler)
 
-    head = rows[0]
-    extra = [
-        {"metric": f"serve_req_latency_p50_ms_bs{head['slots']}",
-         "value": head["lat_p50_ms"], "unit": "ms"},
-        {"metric": f"serve_req_latency_p99_ms_bs{head['slots']}",
-         "value": head["lat_p99_ms"], "unit": "ms"},
-        {"metric": f"serve_ttft_p50_ms_bs{head['slots']}",
-         "value": head["ttft_p50_ms"], "unit": "ms"},
-        {"metric": f"serve_ttft_p99_ms_bs{head['slots']}",
-         "value": head["ttft_p99_ms"], "unit": "ms"},
-    ] + [
-        {"metric": f"serve_decode_tok_per_s_bs{r['slots']}",
-         "value": r["tok_per_s"], "unit": "tokens/sec",
-         "percentiles": {"p50_ms": r["lat_p50_ms"],
-                         "p99_ms": r["lat_p99_ms"]}}
-        for r in rows[1:]
-    ]
-    artifact = {
-        "metric": f"serve_decode_tok_per_s_bs{head['slots']}",
-        "value": head["tok_per_s"],
-        "unit": "tokens/sec",
-        "vs_baseline": 0.0,
-        "note": (f"continuous batching: {head['requests']} reqs, "
-                 f"{head['tokens']} tokens in {head['elapsed_s']}s over "
-                 f"{head['steps']} engine steps "
-                 f"(d{cfg['dim']} l{cfg['layers']} "
-                 f"prompts {cfg['pmin']}-{cfg['pmax']}, Poisson "
-                 f"rate {cfg['rate']}/s); no anchor row exists"),
-        "percentiles": {"p50_ms": head["lat_p50_ms"],
-                        "p99_ms": head["lat_p99_ms"],
-                        "ttft_p50_ms": head["ttft_p50_ms"],
-                        "ttft_p99_ms": head["ttft_p99_ms"]},
-        "extra_metrics": extra,
-    }
     line = json.dumps(artifact)
     print(line, flush=True)
     if args.out:
