@@ -10,3 +10,11 @@ from .master import (  # noqa: F401
     MasterService,
     master_reader,
 )
+from .service import (  # noqa: F401
+    JobSpec,
+    TrainingJob,
+    TrainingService,
+    WorkerKilled,
+    prove_job_recovery,
+)
+from . import chaos  # noqa: F401

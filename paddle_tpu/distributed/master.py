@@ -15,8 +15,10 @@ in for etcd."""
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import random
 import socket
 import socketserver
 import threading
@@ -43,10 +45,17 @@ class MasterService:
         self.snapshot_path = snapshot_path
         self._lock = threading.Lock()
         self._todo: List[Task] = []
-        self._pending: Dict[int, tuple] = {}  # id -> (Task, deadline)
+        # id -> (Task, deadline, trainer_id, leased_at)
+        self._pending: Dict[int, tuple] = {}
         self._done: List[Task] = []
         self._epoch = 0
         self._next_id = 0
+        # trainer_id -> last heartbeat timestamp (lease liveness: the
+        # chaos runner and the training service read ages off progress())
+        self._trainers: Dict[str, float] = {}
+        # last N lease-expiry requeues, newest last: the chaos runner
+        # asserts requeue latency (overdue_s) against the lease timeout
+        self._requeue_log = collections.deque(maxlen=64)
         # per-client-nonce last (seq, reply): transport retry dedup
         self._rpc_cache: Dict[str, tuple] = {}
         if snapshot_path and os.path.exists(snapshot_path):
@@ -80,7 +89,9 @@ class MasterService:
                 else:
                     return None
             t = self._todo.pop(0)
-            self._pending[t.task_id] = (t, time.time() + self.timeout_s)
+            now = time.time()
+            self._pending[t.task_id] = (t, now + self.timeout_s,
+                                        str(trainer_id), now)
             self._snapshot_locked()
             return {"task_id": t.task_id, "payload": t.payload,
                     "epoch": t.epoch}
@@ -117,14 +128,43 @@ class MasterService:
 
     def _requeue_timeouts_locked(self):
         now = time.time()
-        for tid in [tid for tid, (_, dl) in self._pending.items()
-                    if dl < now]:
-            t, _ = self._pending.pop(tid)
+        for t_id in [t for t, ts in self._trainers.items()
+                     if now - ts > self._TRAINER_TTL_S]:
+            del self._trainers[t_id]
+        for tid in [tid for tid, ent in self._pending.items()
+                    if ent[1] < now]:
+            t, deadline, trainer, leased_at = self._pending.pop(tid)
             t.num_failures += 1
+            self._requeue_log.append({
+                "task_id": tid, "trainer_id": trainer,
+                "leased_at": leased_at, "requeued_at": now,
+                "lease_timeout_s": self.timeout_s,
+                # how long past the lease expiry the requeue landed:
+                # the chaos runner's requeue-latency assertion
+                "overdue_s": round(now - deadline, 4),
+            })
             if t.num_failures < self.failure_max:
                 self._todo.append(t)
             else:
                 self._done.append(t)
+
+    # -- lease liveness (service/chaos-runner introspection) ----------------
+    # heartbeat records older than this are pruned in the timeout sweep:
+    # a long-lived master serving churning trainer ids must not grow its
+    # liveness table forever.  Far above any stall-detection threshold
+    # (the service's first-step grace is 60s) so pruning never hides a
+    # stall the monitor still cares about.
+    _TRAINER_TTL_S = 600.0
+
+    def heartbeat(self, trainer_id: str) -> dict:
+        """Record trainer liveness; the training service declares a worker
+        dead when its heartbeat age exceeds the lease timeout (the Go
+        master leaned on etcd leases for this; here the master itself is
+        the lease authority)."""
+        now = time.time()
+        with self._lock:
+            self._trainers[str(trainer_id)] = now
+            return {"server_time": now}
 
     # -- transport retry dedup (lost-reply replays: the client retries a
     # processed get_task and would otherwise receive a SECOND task while
@@ -144,9 +184,24 @@ class MasterService:
 
     # -- introspection ------------------------------------------------------
     def progress(self) -> dict:
+        now = time.time()
         with self._lock:
-            return {"epoch": self._epoch, "todo": len(self._todo),
-                    "pending": len(self._pending), "done": len(self._done)}
+            self._requeue_timeouts_locked()
+            return {
+                "epoch": self._epoch, "todo": len(self._todo),
+                "pending": len(self._pending), "done": len(self._done),
+                # per-trainer heartbeat age + per-task lease state: the
+                # chaos runner asserts requeue latency from these
+                "trainers": {tid: round(now - ts, 4)
+                             for tid, ts in self._trainers.items()},
+                "leases": [
+                    {"task_id": tid, "trainer_id": trainer,
+                     "age_s": round(now - leased_at, 4),
+                     "expires_in_s": round(deadline - now, 4)}
+                    for tid, (t, deadline, trainer, leased_at)
+                    in self._pending.items()],
+                "requeues": list(self._requeue_log),
+            }
 
     def request_save_model(self, trainer_id: str = "",
                            block_ms: float = 0.0) -> int:
@@ -170,8 +225,9 @@ class MasterService:
             "next_id": self._next_id,
             "todo": [(t.task_id, t.payload, t.epoch, t.num_failures)
                      for t in self._todo] +
-                    [(t.task_id, t.payload, t.epoch, t.num_failures)
-                     for t, _ in self._pending.values()],
+                    [(ent[0].task_id, ent[0].payload, ent[0].epoch,
+                      ent[0].num_failures)
+                     for ent in self._pending.values()],
             "done": [(t.task_id, t.payload, t.epoch, t.num_failures)
                      for t in self._done],
         }
@@ -253,12 +309,16 @@ class MasterClient:
     framed socket protocol both assume one in-flight request per client
     (ADVICE r2)."""
 
-    def __init__(self, addr, retries: int = 3):
+    def __init__(self, addr, retries: int = 5, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0, deadline_s: float = 30.0):
         import threading
         import uuid
 
         self.addr = tuple(addr)
         self.retries = retries
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.deadline_s = deadline_s
         self._sock = None
         self._file = None
         self._nonce = uuid.uuid4().hex[:12]
@@ -274,10 +334,16 @@ class MasterClient:
             return self._call_locked(method, *args)
 
     def _call_locked(self, method, *args):
+        """Retry with exponential backoff + full jitter under an overall
+        deadline (the old 3 immediate 0.1s retries hammered a restarting
+        master exactly when it was busiest, and gave up in 0.3s — less
+        than any realistic failover window)."""
         last = None
         self._seq += 1
         seq = f"{self._nonce}:{self._seq}"  # same token on every retry
-        for _ in range(self.retries):
+        t0 = time.monotonic()
+        attempt = 0  # bound even if retries <= 0 slipped through
+        for attempt in range(max(1, self.retries)):
             try:
                 if self._file is None:
                     self._connect()
@@ -293,8 +359,19 @@ class MasterClient:
             except (OSError, ValueError) as e:
                 last = e
                 self._file = None
-                time.sleep(0.1)
-        raise ConnectionError(f"master unreachable: {last}")
+                elapsed = time.monotonic() - t0
+                if attempt + 1 >= self.retries \
+                        or elapsed >= self.deadline_s:
+                    break
+                # full jitter: sleep U(0, min(cap, base*2^attempt)),
+                # clipped to the remaining deadline
+                ceiling = min(self.backoff_max_s,
+                              self.backoff_s * (2 ** attempt))
+                time.sleep(min(random.uniform(0, ceiling),
+                               max(0.0, self.deadline_s - elapsed)))
+        raise ConnectionError(
+            f"master unreachable after {attempt + 1} attempt(s) / "
+            f"{time.monotonic() - t0:.1f}s: {last}")
 
     def set_dataset(self, payloads):
         return self.call("set_dataset", list(payloads))
@@ -307,6 +384,12 @@ class MasterClient:
 
     def task_failed(self, task_id):
         return self.call("task_failed", task_id)
+
+    def put_back(self, task_id):
+        return self.call("put_back", task_id)
+
+    def heartbeat(self, trainer_id):
+        return self.call("heartbeat", trainer_id)
 
     def progress(self):
         return self.call("progress")

@@ -164,6 +164,14 @@ def _enable_compilation_cache():
         min_secs = 1.0 if jax.default_backend() == "cpu" else 10.0
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_secs)
+        # integrity layer (compiler.py): entries are digest-sealed and
+        # written tmp+rename; a corrupt/truncated entry is evicted and
+        # recompiled on read instead of feeding XLA poisoned bytes (the
+        # repeatable startup-compile abort the old NO_COMPILE_CACHE retry
+        # workarounds papered over)
+        from ..compiler import install_compile_cache_integrity
+
+        install_compile_cache_integrity()
     except Exception:  # cache is an optimization, never a failure
         pass
 
@@ -184,6 +192,22 @@ class Executor:
         # programs already verified (analysis/verifier.py), keyed like the
         # executable cache so re-verification only happens on mutation
         self._verified: set = set()
+
+    # -- resume hooks (distributed/service.py checkpoint/restore) -------
+    @property
+    def global_step(self) -> int:
+        """Monotonic run counter — the default PRNG fold-in step.  A
+        resumed trainer must restore it (or pin `rng_step` per run) so
+        the recovered stochastic stream equals the uninterrupted one."""
+        return self._step
+
+    def snapshot_state(self) -> dict:
+        """JSON-serializable executor state for trainer checkpoints."""
+        return {"step": int(self._step)}
+
+    def restore_state(self, state: dict):
+        """Inverse of snapshot_state — the checkpoint/resume hook."""
+        self._step = int(state.get("step", 0))
 
     def optimized_hlo(self, program=None, feed=None, fetch_list=None,
                       scope=None, block_id: int = 0) -> str:

@@ -22,37 +22,31 @@ JAX_PLATFORMS=cpu python tools/lint_smoke.py
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m paddle_tpu analyze --sharding > /dev/null
 
+# chaos smoke (docs/distributed.md): one seeded worker-kill against the
+# elastic training service, recovery proved equivalent to the
+# uninterrupted reference by the PR 10 differential oracle — <30s, fails
+# before the long pytest tier when the recovery ladder regresses.
+# Same native-flake retry wrapper as the serve smoke below.
+env JAX_PLATFORMS=cpu python tools/cache_guard.py --attempts 3 -- \
+    python tools/chaos_run.py --smoke > /dev/null \
+    || { echo "chaos smoke failed (rc=$?)"; exit 1; }
+
 # serving smoke (docs/serving.md): tiny-model fifo-vs-v2 A/B on CPU with
 # the verifier armed — greedy outputs must be token-identical across the
 # schedulers and the prefix cache must actually hit — then `paddle_tpu
 # lint` over the engine-built programs (decode + the v2 mixed
 # chunked-prefill/decode + COW page-copy) so the PR 6 verifier covers
-# the whole serving tier
+# the whole serving tier.  Native-flake signal deaths retry through
+# tools/cache_guard.py (the single home of that workaround; the
+# compile-cache integrity layer in paddle_tpu/compiler.py fixed the
+# poisoned-entry crash class at the source)
 serve_progs=$(mktemp -d)
 trap 'rm -rf "$serve_progs"' EXIT
-# signal deaths (rc >= 128) are the known flaky native XLA-CPU tracer
-# crash — the family tests/_native_isolation.py contains in the suite —
-# so those retry; a real smoke failure (rc 1: divergent tokens, cold
-# cache, leak) never does.  From the 2nd attempt the persistent XLA
-# compile cache is dropped: a poisoned cache entry crashes the SAME way
-# every time, so without this the retries rerun one deterministic crash
-# instead of rolling the flake again (observed: 15 consecutive rc=134
-# startup-compile aborts that a cache-less run cleared first try)
-smoke_rc=1
-for attempt in 1 2 3; do
-    rm -rf "$serve_progs"; mkdir -p "$serve_progs"
-    smoke_rc=0
-    cache_flag=""
-    if [ "$attempt" -gt 1 ]; then cache_flag="PADDLE_TPU_NO_COMPILE_CACHE=1"; fi
-    env $cache_flag JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=1 \
-        python tools/serve_bench.py --smoke \
-        --scheduler ab --save-programs "$serve_progs" > /dev/null \
-        || smoke_rc=$?
-    [ "$smoke_rc" -eq 0 ] && break
-    [ "$smoke_rc" -ge 128 ] || exit "$smoke_rc"
-    echo "serve smoke died with rc=$smoke_rc (native flake), attempt $attempt"
-done
-[ "$smoke_rc" -eq 0 ] || { echo "serve smoke kept crashing"; exit 1; }
+env JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=1 \
+    python tools/cache_guard.py --attempts 3 --fresh-dir "$serve_progs" -- \
+    python tools/serve_bench.py --smoke \
+    --scheduler ab --save-programs "$serve_progs" > /dev/null \
+    || { echo "serve smoke failed (rc=$?)"; exit 1; }
 for p in "$serve_progs"/*.json; do
     JAX_PLATFORMS=cpu python -m paddle_tpu lint "$p" > /dev/null \
         || { echo "serving program lint failed: $p"; exit 1; }

@@ -251,6 +251,16 @@ CAPTURES = [
     # partitioner-collapse decision always cites a current sweep
     ("plan_equivalence",
      [sys.executable, "tools/hlo_analysis.py", "equiv"], {}, 600),
+    # chaos matrix (ISSUE 12): the elastic-service fault catalog (worker
+    # kill mid-pass, kill-during-checkpoint, master death, heartbeat
+    # stall, corrupt checkpoint) x 2 seeds, every cell's recovery
+    # PROVEN equal to an uninterrupted run by the PR 10 differential
+    # oracle, plus the 16k-context fit-because-remat admission demo —
+    # the first on-chip proof that the recovery ladder is bit-exact on
+    # real hardware, not just under the CPU mesh
+    ("chaos_matrix",
+     [sys.executable, "tools/chaos_run.py", "--matrix", "--seeds", "2"],
+     {}, 1200),
     ("unet",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "unet", "BENCH_ITERS": "10"}, 580),

@@ -30,6 +30,15 @@ Three classes of rot this repo has actually accumulated:
      in the ``docs/analysis.md`` rule catalog (PTV001–024 were drifting
      apart by hand), and the docs must not carry rows for rules the
      verifier no longer registers.
+  7. checkpoint-directory writes outside ``distributed/checkpoint.py``
+     — the chaos suite's crash-recovery proof rests on every byte in a
+     ``ckpt_<n>`` dir (and the LATEST pointer) being published by one
+     audited tmp+rename path; an ``open(...ckpt..., "w")`` or
+     ``np.save(...ckpt...)`` anywhere else in ``paddle_tpu/`` or
+     ``tools/`` is a torn-write hole the fallback logic cannot see.
+     Line-anchored like the page-table rule (an aliased path slips
+     through): a tripwire, not an AST proof.  `tests/` are exempt —
+     they corrupt checkpoints on purpose.
 
 Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
 any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
@@ -152,6 +161,54 @@ def _check_page_table(root, dirpath, filenames, findings):
             pass
 
 
+# the atomic-checkpoint guard: a write-mode open / np.save on a line
+# that names a checkpoint path literal (ckpt_ staging dirs, the LATEST
+# pointer) anywhere under paddle_tpu/ or tools/ except the one audited
+# writer.  Two line-level tests (marker anywhere + write call anywhere)
+# rather than one regex spanning the argument list: path literals
+# usually sit inside an os.path.join(...) the single-pattern scan
+# cannot cross.  Read-mode opens don't match (w/a/x/r+ only).
+_CKPT_MARK_RE = re.compile(r"ckpt_|\bLATEST\b")
+_CKPT_WRITE_CALL_RE = re.compile(
+    r"\bopen\s*\(.*,\s*[\"'](?:[wax]|r\+)"
+    r"|\bnp\.savez?\s*\(|\bshutil\.copy")
+_CKPT_WRITE_DIRS = ("paddle_tpu", "tools")
+# the audited atomic writer, plus the chaos runner whose JOB is to
+# corrupt checkpoints (fault injection is the one sanctioned exception)
+_CKPT_WRITE_OK = {
+    os.path.join("paddle_tpu", "distributed", "checkpoint.py"),
+    os.path.join("paddle_tpu", "distributed", "chaos.py"),
+}
+
+
+def _check_ckpt_writes(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    top = rel_dir.split(os.sep)[0]
+    if top not in _CKPT_WRITE_DIRS:
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel in _CKPT_WRITE_OK or rel == os.path.join(
+                "tools", "repo_lint.py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _CKPT_MARK_RE.search(line) \
+                            and _CKPT_WRITE_CALL_RE.search(line):
+                        findings.append(
+                            f"non-atomic checkpoint-directory write: "
+                            f"{rel}:{i} (only distributed/checkpoint.py"
+                            f" may write into ckpt_*/LATEST — its "
+                            f"tmp+rename path is what the chaos "
+                            f"recovery proof audits)")
+        except OSError:
+            pass
+
+
 # the PTV rule/doc drift guard: rule registrations in verifier.py vs
 # catalog rows in docs/analysis.md
 _RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
@@ -224,6 +281,7 @@ def lint(root: str):
         _check_compiler_params(root, dirpath, filenames, findings)
         _check_partition_spec(root, dirpath, filenames, findings)
         _check_page_table(root, dirpath, filenames, findings)
+        _check_ckpt_writes(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)
