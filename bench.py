@@ -156,7 +156,11 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
         if profiling:
             jax.profiler.start_trace(profile_dir)
         try:
-            t0 = time.perf_counter()
+            # the one sanctioned timing clock (observability/metrics.py;
+            # tools/repo_lint.py forbids ad-hoc perf_counter timing)
+            from paddle_tpu.observability.metrics import monotime
+
+            t0 = monotime()
             if feed_stream:
                 dev = exe.place.jax_device()
                 for i in range(iters):
@@ -176,7 +180,16 @@ def _timed_loop(exe, feed, fetch, warmup, iters, program=None,
             # readiness without having executed — a device->host read of
             # the result is the only wait the transport must honor
             np.asarray(out).ravel()[:1]
-            passes.append((time.perf_counter() - t0) / iters)
+            dt = (monotime() - t0) / iters
+            passes.append(dt)
+            # the pass also lands in the shared registry; exported by
+            # _export_metrics() when BENCH_METRICS=<file> is set
+            from paddle_tpu.observability.metrics import REGISTRY
+
+            REGISTRY.histogram(
+                "bench_pass_seconds",
+                "per-iteration wall time of bench timing passes").observe(
+                dt)
         finally:
             # a pass that dies mid-profile must still flush the partial
             # trace — it may be the only artifact the capture gets
@@ -876,6 +889,26 @@ def main():
                              "vs_baseline": 0.0,
                              "error": f"{type(e).__name__}: {e}"}
         emit()
+    _export_metrics()
+
+
+def _export_metrics():
+    """BENCH_METRICS=<file>: dump this process's metrics-registry
+    snapshot (bench_pass_seconds, executor/compile-cache counters) —
+    the registry consumer that makes the in-loop observes visible."""
+    path = os.environ.get("BENCH_METRICS")
+    if not path:
+        return
+    try:
+        from paddle_tpu import observability as obs
+
+        problems = obs.export_telemetry(
+            metrics_obj=obs.REGISTRY.snapshot(), metrics_path=path)
+        if problems:
+            print(f"# telemetry schema problems: {problems}",
+                  file=sys.stderr)
+    except Exception as e:  # telemetry must never fail a bench run
+        print(f"# metrics export failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,12 @@ Usage: python -m paddle_tpu <subcommand> [args]
                           must be idempotent through a serialize round
                           trip (the CI fast tier runs this over the
                           book models)
+  metrics DIR|FILE      — run N traced steps of a saved model under the
+                          telemetry layer (observability/) and print the
+                          metrics registry: Prometheus text, or --json
+                          for the snapshot + predicted-vs-measured report
+  trace DIR|FILE        — same run, writing the Chrome/Perfetto
+                          trace-event JSON (open in ui.perfetto.dev)
   show_pb DIR|FILE      — human-readable dump of blocks/ops/vars
   pserver ...           — host parameter service (distributed/pserver)
   master ...            — fault-tolerant task-dispatch service
@@ -438,6 +444,85 @@ def cmd_diff(args) -> int:
     return 0 if proof.equivalent else 1
 
 
+def _telemetry_run(args):
+    """Shared runner for the `metrics` and `trace` subcommands: load a
+    saved model, attach predicted-vs-measured accounting, drive N
+    executor steps on deterministic synthetic feeds (the equivalence
+    oracle's feed/state seeding) with the tracer enabled, and record
+    the measured peak.  Returns the observability module, whose
+    registry/tracer/accounting now hold the run."""
+    from . import observability as obs
+    from .analysis import equivalence as eqv
+    from .analysis.dataflow import state_classes
+    from .framework.executor import Executor
+    from .framework.place import CPUPlace
+    from .framework.scope import Scope
+
+    program, feed, fetch = _load_program_any(args.model)
+    block = program.global_block()
+    if fetch is None:
+        fetch = eqv.sink_outputs(block)
+    if feed is None:
+        feed = [v.name for v in block.vars.values() if v.is_data]
+    obs.enable_tracing()
+    label = os.path.basename(os.path.normpath(args.model)) or "model"
+    obs.accounting.track(program, label, batch_size=args.batch_size)
+    feeds = eqv.build_feeds(program, feed, batch_size=args.batch_size)
+    scope = _load_scope_for(args.model) or Scope()
+    # saved dirs carry persistables; anything else the block reads is
+    # seeded deterministically by name, the differential-oracle idiom
+    ext, rw, _ = state_classes(block, list(feeds))
+    for name in list(ext) + list(rw):
+        if scope.find(name) is not None:
+            continue
+        dv = block._find_var_recursive(name)
+        if dv is not None and dv.shape is not None:
+            scope.set(name, eqv._seed_array(
+                name, eqv._bind(dv.shape, 1), dv.dtype or "float32", 0))
+    exe = Executor(CPUPlace())
+    for i in range(max(1, args.steps)):
+        with obs.span("telemetry.step", step=i):
+            exe.run(program, feed=dict(feeds), fetch_list=list(fetch),
+                    scope=scope, rng_step=i)
+    obs.accounting.record_measured_peak(program, exe, feed=dict(feeds),
+                                        fetch_list=list(fetch),
+                                        scope=scope)
+    return obs
+
+
+def cmd_metrics(args) -> int:
+    """Run a saved model under the telemetry layer and print the
+    registry state: Prometheus text by default, --json for the snapshot
+    (with the predicted-vs-measured report attached)."""
+    import json as _json
+
+    obs = _telemetry_run(args)
+    if args.trace_out:
+        obs.TRACER.export(args.trace_out)
+        print(f"# trace written to {args.trace_out}", file=sys.stderr)
+    if args.json:
+        body = obs.REGISTRY.snapshot()
+        body["pred_vs_measured"] = obs.accounting.report()
+        print(_json.dumps(body))
+    else:
+        print(obs.REGISTRY.render_prometheus(), end="")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Run a saved model under the tracer and write the Chrome/Perfetto
+    trace-event JSON (open it at https://ui.perfetto.dev)."""
+    obs = _telemetry_run(args)
+    out = args.out or (os.path.basename(os.path.normpath(args.model))
+                       + ".trace.json")
+    obs.TRACER.export(out)
+    problems = obs.validate_chrome_trace(obs.TRACER.to_chrome())
+    n = len(obs.TRACER.events())
+    print(f"{out}: {n} events"
+          + (f"; SCHEMA PROBLEMS: {problems}" if problems else ""))
+    return 1 if problems else 0
+
+
 def cmd_show_pb(args) -> int:
     from .utils import show_pb
 
@@ -470,6 +555,12 @@ def cmd_master(args) -> int:
                         failure_max=args.failure_max,
                         snapshot_path=args.snapshot)
     srv = MasterServer(svc, host=args.host, port=args.port).start()
+    if args.telemetry_port is not None:
+        from .observability.httpd import serve_http
+
+        tele = serve_http(args.telemetry_port)
+        print(f"telemetry on http://127.0.0.1:{tele.port}/metrics "
+              f"(+ /metrics.json, /trace)", flush=True)
     print(f"master serving on {srv.addr[0]}:{srv.addr[1]}", flush=True)
     try:
         srv._thread.join()
@@ -570,6 +661,31 @@ def main(argv=None) -> int:
                    help="one JSON line instead of the human report")
     p.set_defaults(fn=cmd_diff)
 
+    p = sub.add_parser("metrics")
+    p.add_argument("model", help="saved model dir, __model__ file, or "
+                                 "program.json")
+    p.add_argument("--steps", type=int, default=5,
+                   help="executor steps to drive (first compiles)")
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="binds -1 feed dims of the synthetic feeds")
+    p.add_argument("--json", action="store_true",
+                   help="registry snapshot JSON (+ pred_vs_measured "
+                        "report) instead of Prometheus text")
+    p.add_argument("--trace-out", default=None,
+                   help="also write the step trace JSON here")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("trace")
+    p.add_argument("model", help="saved model dir, __model__ file, or "
+                                 "program.json")
+    p.add_argument("--steps", type=int, default=5,
+                   help="executor steps to drive (first compiles)")
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="binds -1 feed dims of the synthetic feeds")
+    p.add_argument("--out", default=None,
+                   help="trace path (default MODEL.trace.json)")
+    p.set_defaults(fn=cmd_trace)
+
     p = sub.add_parser("merge_model")
     p.add_argument("model_dir")
     p.add_argument("out")
@@ -590,6 +706,9 @@ def main(argv=None) -> int:
     p.add_argument("--failure-max", type=int, default=3)
     p.add_argument("--snapshot", default=None,
                    help="task-queue snapshot file (restart recovery)")
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   help="opt-in localhost /metrics + /trace endpoint "
+                        "(0 = any free port)")
     p.set_defaults(fn=cmd_master)
 
     # `paddle cluster_train ...` — one-command multi-host launch

@@ -67,14 +67,27 @@ def unseal_cache_entry(raw: bytes) -> Optional[bytes]:
 
 
 class _IntegrityCache:
-    """CacheInterface wrapper: digest-verified get, atomic sealed put."""
+    """CacheInterface wrapper: digest-verified get, atomic sealed put.
+    Every outcome is counted in the metrics registry
+    (``compile_cache_total{result=hit|miss|evicted_corrupt}``) — the
+    PR 12 integrity layer's behavior was previously observable only by
+    its absence of crashes."""
 
     def __init__(self, inner):
         self._inner = inner
 
+    def _count(self, result: str):
+        from .observability.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "compile_cache_total",
+            "persistent XLA compile-cache reads by outcome").inc(
+            result=result)
+
     def get(self, key: str):
         raw = self._inner.get(key)
         if raw is None:
+            self._count("miss")
             return None
         val = unseal_cache_entry(raw)
         if val is None:
@@ -82,13 +95,20 @@ class _IntegrityCache:
             # recompiles instead of aborting on poisoned bytes and (b)
             # the recompile's put is not refused by put's exists() check
             self._evict(key)
+            self._count("evicted_corrupt")
             return None
+        self._count("hit")
         return val
 
     def put(self, key: str, val: bytes):
+        from .observability.metrics import REGISTRY
+
         sealed = seal_cache_entry(val)
         if not self._atomic_put(key, sealed):
             self._inner.put(key, sealed)  # still sealed, just not atomic
+        REGISTRY.counter(
+            "compile_cache_puts_total",
+            "persistent XLA compile-cache entries written").inc()
 
     # -- plumbing -------------------------------------------------------
     def _paths(self, key):

@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..observability.metrics import REGISTRY as _MET
+
 
 @dataclass
 class Task:
@@ -93,6 +95,8 @@ class MasterService:
             self._pending[t.task_id] = (t, now + self.timeout_s,
                                         str(trainer_id), now)
             self._snapshot_locked()
+            _MET.counter("master_leases_granted_total",
+                         "tasks leased to trainers").inc()
             return {"task_id": t.task_id, "payload": t.payload,
                     "epoch": t.epoch}
 
@@ -101,6 +105,8 @@ class MasterService:
             ent = self._pending.pop(task_id, None)
             if ent is not None:
                 self._done.append(ent[0])
+                _MET.counter("master_tasks_finished_total",
+                             "leases acked complete").inc()
             self._snapshot_locked()
 
     def put_back(self, task_id: int):
@@ -143,6 +149,12 @@ class MasterService:
                 # the chaos runner's requeue-latency assertion
                 "overdue_s": round(now - deadline, 4),
             })
+            _MET.counter("master_requeues_total",
+                         "expired leases returned to the queue").inc()
+            _MET.histogram(
+                "master_requeue_overdue_seconds",
+                "delay between lease expiry and its requeue").observe(
+                max(0.0, now - deadline))
             if t.num_failures < self.failure_max:
                 self._todo.append(t)
             else:
@@ -162,6 +174,8 @@ class MasterService:
         master leaned on etcd leases for this; here the master itself is
         the lease authority)."""
         now = time.time()
+        _MET.counter("master_heartbeats_total",
+                     "trainer heartbeats received").inc()
         with self._lock:
             self._trainers[str(trainer_id)] = now
             return {"server_time": now}

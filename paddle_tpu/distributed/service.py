@@ -48,6 +48,8 @@ from ..framework.core import Program, program_guard
 from ..framework.executor import Executor
 from ..framework.scope import Scope
 from ..framework import unique_name
+from ..observability.metrics import REGISTRY as _MET
+from ..observability.tracing import TRACER as _TRC
 from .checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 from .master import MasterService
 
@@ -167,8 +169,11 @@ class TrainingJob:
         from the newest good checkpoint (falling back past corrupt
         snapshots), restart the pass from there."""
         with self._steplock:
-            self.generation += 1
-            self.bootstrap()
+            with _TRC.span("trainsvc.rollback", job=self.spec.name,
+                           reason=reason[:120],
+                           generation=self.generation):
+                self.generation += 1
+                self.bootstrap()
 
     # -- the training step (workers call these) -------------------------
     def run_task(self, task: dict, gen: int, master=None, chaos=None,
@@ -305,12 +310,18 @@ class TrainingService:
                  headroom: float = 0.9,
                  monitor_interval_s: float = 0.05,
                  max_recoveries_per_job: int = 8,
-                 first_step_grace_s: float = 60.0):
+                 first_step_grace_s: float = 60.0,
+                 telemetry_port: Optional[int] = None):
         self.hbm_budget_bytes = int(hbm_budget_bytes)
         self.root_dir = root_dir
         self.headroom = float(headroom)
         self.monitor_interval_s = monitor_interval_s
         self.max_recoveries_per_job = max_recoveries_per_job
+        # opt-in /metrics + /trace HTTP endpoint (observability/httpd.py):
+        # None = off (the default — this exposes process internals);
+        # 0 = any free port (read .telemetry.port after start())
+        self.telemetry_port = telemetry_port
+        self.telemetry = None
         # stall threshold before a generation's first step completes: a
         # worker mid-jit-compile heartbeats nothing for the whole step,
         # and misreading compile as a stall would burn a rollback (and,
@@ -356,6 +367,14 @@ class TrainingService:
                        f"exceeds {self.headroom:.0%} of free budget "
                        f"{free} and the job does not allow remat")
         self.certificates.append(cert)
+        _MET.counter(
+            "trainsvc_admissions_total",
+            "job admission decisions by the static HBM gate").inc(
+            decision="admitted" if cert["admitted"] else "rejected",
+            remat="yes" if cert.get("remat") else "no")
+        _TRC.instant("trainsvc.admit", job=spec.name,
+                     admitted=bool(cert["admitted"]),
+                     peak_bytes=int(cert.get("peak_bytes", -1)))
         if cert["admitted"]:
             self.jobs[spec.name] = job
             self._admitted_peak[spec.name] = int(cert["peak_bytes"])
@@ -432,6 +451,10 @@ class TrainingService:
     # -- run ------------------------------------------------------------
     def start(self, chaos=None):
         self.chaos = chaos if chaos is not None else _NullChaos()
+        if self.telemetry_port is not None and self.telemetry is None:
+            from ..observability.httpd import serve_http
+
+            self.telemetry = serve_http(self.telemetry_port)
         for job in self.jobs.values():
             job.bootstrap()
             if job.step >= job.spec.target_steps:
@@ -491,6 +514,11 @@ class TrainingService:
         event = {"job": job.spec.name, "reason": reason,
                  "at_step": job.step, "generation": job.generation,
                  "time": time.time()}
+        _MET.counter("trainsvc_recoveries_total",
+                     "rollback-to-checkpoint recoveries triggered").inc(
+            job=job.spec.name)
+        _TRC.instant("trainsvc.recover", job=job.spec.name,
+                     reason=reason[:120], at_step=job.step)
         for w in self._workers.get(job.spec.name, []):
             w.stop_evt.set()
         n_prior = sum(1 for r in self.recoveries
@@ -541,6 +569,9 @@ class TrainingService:
         for ws in self._workers.values():
             for w in ws:
                 w.join(timeout=5)
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
 
 
 # ---------------------------------------------------------------------------

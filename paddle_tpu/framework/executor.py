@@ -25,12 +25,24 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import accounting as _acct
+from ..observability.metrics import REGISTRY as _MET, monotime as _monotime
+from ..observability.tracing import TRACER as _TRC
 from ..ops.registry import EmitContext, get_op_info
 from .core import Program, Variable, canonical_dtype, np_dtype
 from .place import Place, default_place
 from .scope import Scope, global_scope
 
 logger = logging.getLogger("paddle_tpu")
+
+# counter handles resolved once (families survive REGISTRY.reset()):
+# these sit on the per-run hot path, where a per-step family lookup
+# (name regex + registry lock) would be pure overhead
+_MET_STEPS = _MET.counter("executor_steps_total",
+                          "completed Executor.run invocations")
+_MET_PROG_CACHE = _MET.counter(
+    "executor_program_cache_total",
+    "executable-cache lookups by Executor.run")
 
 # ops the lowerer skips: pure-desc markers with no computation
 _NOOP_TYPES = ("feed", "fetch")
@@ -328,6 +340,7 @@ class Executor:
         feed = feed or {}
         fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
         scope = scope if scope is not None else global_scope()
+        t_run0 = _monotime()
 
         if verify is None:
             from ..analysis.verifier import env_verify_enabled
@@ -346,34 +359,44 @@ class Executor:
         # an unbounded trail of dead cache entries
         load_sig = self._load_file_sig(program)
         entry = self._cache.get(key)
-        if entry is None or entry[0] != load_sig:
-            compiled = self._compile(program, block_id, feed_vals, fetch_names)
+        compiled_now = entry is None or entry[0] != load_sig
+        if compiled_now:
+            with _TRC.span("executor.compile", ops=len(block.ops)):
+                compiled = self._compile(program, block_id, feed_vals,
+                                         fetch_names)
             self._cache[key] = (load_sig, compiled)
         else:
             compiled = entry[1]
+        _MET_PROG_CACHE.inc(result="miss" if compiled_now else "hit")
 
         import jax
 
-        state_w = {}
-        for n in compiled.rw_state:
-            v = scope.find(n)
-            if v is None:
-                raise RuntimeError(
-                    f"variable {n!r} used before initialization — run the "
-                    f"startup program first (fluid semantics)"
-                )
-            state_w[n] = self._pin_host_array(scope, n, v)
-        state_r = {}
-        for n in compiled.external_reads:
-            v = scope.find(n)
-            if v is None:
-                bvar = block._find_var_recursive(n)
-                if bvar is not None and bvar.is_data:
+        # telemetry: the DONATION phase — pinning the donated (rw) and
+        # read-only state buffers into device memory before the step
+        with _TRC.span("executor.donate", feeds=len(feed)) as sp_don:
+            state_w = {}
+            for n in compiled.rw_state:
+                v = scope.find(n)
+                if v is None:
                     raise RuntimeError(
-                        f"data variable {n!r} was not fed — add it to `feed`"
+                        f"variable {n!r} used before initialization — run "
+                        f"the startup program first (fluid semantics)"
                     )
-                raise RuntimeError(f"variable {n!r} not initialized in scope")
-            state_r[n] = self._pin_host_array(scope, n, v)
+                state_w[n] = self._pin_host_array(scope, n, v)
+            state_r = {}
+            for n in compiled.external_reads:
+                v = scope.find(n)
+                if v is None:
+                    bvar = block._find_var_recursive(n)
+                    if bvar is not None and bvar.is_data:
+                        raise RuntimeError(
+                            f"data variable {n!r} was not fed — add it to "
+                            f"`feed`"
+                        )
+                    raise RuntimeError(
+                        f"variable {n!r} not initialized in scope")
+                state_r[n] = self._pin_host_array(scope, n, v)
+            sp_don.note(donated=len(state_w), reads=len(state_r))
 
         rng = jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed),
@@ -388,7 +411,9 @@ class Executor:
             return c.fn(state_w, state_r, feed_vals, rng)
 
         try:
-            fetches, new_state = invoke(compiled)
+            with _TRC.span("executor.execute",
+                           cache_hit=not compiled_now):
+                fetches, new_state = invoke(compiled)
         except Exception as e:
             # Runtime fallback for the fused Pallas kernels: a Mosaic
             # compilation failure on some shape/toolchain must degrade a
@@ -414,30 +439,37 @@ class Executor:
                 f"the process (set PADDLE_TPU_NO_FUSED_KERNELS=1 to skip "
                 f"the attempt): {type(e).__name__}: {str(e)[:300]}")
             _pk.runtime_disable(f"{type(e).__name__}: {str(e)[:200]}")
-            compiled = self._compile(program, block_id, feed_vals,
-                                     fetch_names)
+            with _TRC.span("executor.compile", ops=len(block.ops),
+                           retrace="mosaic_fallback"):
+                compiled = self._compile(program, block_id, feed_vals,
+                                         fetch_names)
+            compiled_now = True
             self._cache[key] = (load_sig, compiled)
             state_w = {n: self._pin_host_array(scope, n, scope.find(n))
                        for n in compiled.rw_state}
             state_r = {n: self._pin_host_array(scope, n, scope.find(n))
                        for n in compiled.external_reads}
-            fetches, new_state = invoke(compiled)
-        for n, v in new_state.items():
-            scope.set(n, v)
-        if compiled.save_specs:
-            import os
+            with _TRC.span("executor.execute", cache_hit=False):
+                fetches, new_state = invoke(compiled)
+        with _TRC.span("executor.writeback", written=len(new_state)):
+            for n, v in new_state.items():
+                scope.set(n, v)
+            if compiled.save_specs:
+                import os
 
-            for i, (path, overwrite) in enumerate(compiled.save_specs):
-                if os.path.exists(path) and not overwrite:
-                    raise IOError(
-                        f"save op: {path!r} exists and overwrite=False "
-                        f"(save_op.cc semantics)")
-                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-                # write through a file object: np.save(path) would append
-                # ".npy" to extension-less reference-style paths
-                with open(path, "wb") as f:
-                    np.save(f, np.asarray(fetches[f"{_SAVE_PREFIX}{i}"]),
-                            allow_pickle=False)
+                for i, (path, overwrite) in enumerate(compiled.save_specs):
+                    if os.path.exists(path) and not overwrite:
+                        raise IOError(
+                            f"save op: {path!r} exists and overwrite=False "
+                            f"(save_op.cc semantics)")
+                    os.makedirs(os.path.dirname(path) or ".",
+                                exist_ok=True)
+                    # write through a file object: np.save(path) would
+                    # append ".npy" to extension-less reference-style paths
+                    with open(path, "wb") as f:
+                        np.save(f,
+                                np.asarray(fetches[f"{_SAVE_PREFIX}{i}"]),
+                                allow_pickle=False)
         if self.check_nan_inf:
             # FLAGS_check_nan_inf analog (reference executor.cc:26,120-128):
             # scan fetches + updated state for non-finite values
@@ -447,6 +479,10 @@ class Executor:
                         np.isfinite(arr)):
                     raise FloatingPointError(
                         f"non-finite values in {n!r} after step {self._step}")
+        _MET_STEPS.inc()
+        # predicted-vs-measured: tracked programs record this step's wall
+        # time (observability/accounting.py; cheap no-op for the rest)
+        _acct.on_step(program, _monotime() - t_run0, compiled_now)
         if return_numpy:
             return [as_numpy(fetches[n]) for n in fetch_names]
         return [fetches[n] for n in fetch_names]

@@ -24,14 +24,44 @@ _PROTO = os.path.join(_HERE, "framework.proto")
 _pb2 = None
 
 
+def _gen_is_current() -> bool:
+    """One staleness predicate for the cached generated module — shared
+    by framework_pb2() and proto_bindings_available() so the
+    regeneration condition can never drift between them."""
+    gen_py = os.path.join(_GEN_DIR, "framework_pb2.py")
+    try:
+        return (os.path.exists(gen_py)
+                and os.path.getmtime(gen_py) >= os.path.getmtime(_PROTO))
+    except OSError:
+        return False
+
+
+def proto_bindings_available() -> bool:
+    """True when framework_pb2() can succeed in THIS environment: the
+    generated module is already cached (and current), or `protoc` is on
+    PATH to generate it.  Tests gate protoc-dependent cases on this so a
+    protoc-less environment yields a deterministic skip instead of the
+    order-dependent pass/fail pair the tier-1 F-stream judgment kept
+    tripping over (ISSUE 13 deflake satellite)."""
+    import importlib.util as ilu
+    import shutil
+
+    if _pb2 is not None:
+        return True
+    # the generated module still imports the google.protobuf runtime —
+    # protoc alone is not enough
+    if ilu.find_spec("google.protobuf") is None:
+        return False
+    return _gen_is_current() or shutil.which("protoc") is not None
+
+
 def framework_pb2():
     """Import (generating if needed) the framework_pb2 module."""
     global _pb2
     if _pb2 is not None:
         return _pb2
     gen_py = os.path.join(_GEN_DIR, "framework_pb2.py")
-    if (not os.path.exists(gen_py)
-            or os.path.getmtime(gen_py) < os.path.getmtime(_PROTO)):
+    if not _gen_is_current():
         os.makedirs(_GEN_DIR, exist_ok=True)
         subprocess.run(
             ["protoc", f"--proto_path={_HERE}", f"--python_out={_GEN_DIR}",

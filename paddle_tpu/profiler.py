@@ -1,39 +1,60 @@
 """Profiler (reference paddle/platform/profiler.h Event/RecordEvent RAII +
 EventItem report, python/paddle/v2/fluid/profiler.py cuda_profiler :32).
 
-Two layers, matching the reference's two:
-  - host event timers: `RecordEvent` context manager accumulating wall time
-    per name into a global report (the reference's Stat/REGISTER_TIMER and
-    Event/EventList), printable via `print_report()`;
-  - device tracing: `profiler()` context manager wrapping `jax.profiler`
-    traces — the XLA/TPU analog of nvprof hooks — producing a TensorBoard-
-    loadable trace directory.
+Since ISSUE 13 this module is a thin compatibility face over
+``paddle_tpu.observability``: the global event table that used to live
+here (one more private metrics dict) is gone — ``RecordEvent`` now
+records into the shared metrics registry (histogram
+``host_event_seconds{name=...}``) and, when tracing is enabled, opens a
+real span in the shared tracer so legacy ``RecordEvent`` call sites
+appear in the Perfetto trace beside the executor/serving spans.  The
+public API (``RecordEvent``/``record_event``/``get_report``/
+``print_report``/``reset_profiler``/``profiler``) is unchanged for
+callers.
+
+Device tracing (``profiler(trace_dir=...)``/``CudaProfiler``) still
+wraps ``jax.profiler`` — the XLA/TPU analog of nvprof hooks — producing
+a TensorBoard-loadable trace directory.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
-import time
-from collections import defaultdict
 from typing import Optional
 
-_lock = threading.Lock()
-_events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # n, total, max, min
-_enabled = [False]
+from .observability.metrics import REGISTRY as _MET, monotime as _monotime
+from .observability.tracing import TRACER as _TRC
+
+_EVENT_FAMILY = "host_event_seconds"
+_HELP = "RecordEvent host timers (profiler.py compatibility face)"
+
+# handle resolved once (families survive REGISTRY.reset(), same pattern
+# as the executor's step counters): RecordEvent sits in per-step loops,
+# where a per-event family lookup would be pure overhead
+_HOST_EVENTS = _MET.histogram(_EVENT_FAMILY, _HELP)
+
+
+def _family():
+    return _HOST_EVENTS
 
 
 def enable_profiler():
-    _enabled[0] = True
+    """API-parity no-op: recording is governed by the shared registry's
+    own gate (on by default; PADDLE_TPU_TELEMETRY=0 opts the process
+    out).  Deliberately NOT _MET.enable() — the legacy profiler switch
+    must never override the documented process-wide opt-out."""
+    pass
 
 
 def disable_profiler():
-    _enabled[0] = False
+    # deliberately NOT registry.disable(): the registry serves every
+    # subsystem, and the legacy profiler switch must not silence the
+    # serving/executor/service counters recorded beside these events
+    pass
 
 
 def reset_profiler():
-    with _lock:
-        _events.clear()
+    _family().clear()
 
 
 class RecordEvent:
@@ -41,19 +62,18 @@ class RecordEvent:
 
     def __init__(self, name: str):
         self.name = name
+        self._span = None
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._span = _TRC.span(f"host.{self.name}", cat="host_event")
+        self._span.__enter__()
+        self._t0 = _monotime()
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
-        with _lock:
-            e = _events[self.name]
-            e[0] += 1
-            e[1] += dt
-            e[2] = max(e[2], dt)
-            e[3] = min(e[3], dt)
+        dt = _monotime() - self._t0
+        self._span.__exit__(*(exc or (None, None, None)))
+        _family().observe(dt, name=self.name)
         return False
 
 
@@ -62,13 +82,15 @@ def record_event(name):
 
 
 def get_report():
-    """EventItem aggregation (profiler.cc report): name → stats dict."""
-    with _lock:
-        return {
-            name: {"calls": n, "total_s": tot, "avg_s": tot / max(n, 1),
-                   "max_s": mx, "min_s": mn if n else 0.0}
-            for name, (n, tot, mx, mn) in _events.items()
-        }
+    """EventItem aggregation (profiler.cc report): name → stats dict,
+    read back from the shared registry (series_stats snapshots under
+    the registry lock, so concurrent RecordEvents are safe)."""
+    out = {}
+    for labels, s in _family().series_stats():
+        out[labels.get("name", "")] = {
+            "calls": s["count"], "total_s": s["sum"],
+            "avg_s": s["avg"], "max_s": s["max"], "min_s": s["min"]}
+    return out
 
 
 def print_report(sorted_by="total_s"):
@@ -96,10 +118,7 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
     ctx = (jax.profiler.trace(trace_dir) if trace_dir
            else contextlib.nullcontext())
     with ctx:
-        t0 = time.perf_counter()
         yield
-        _ = time.perf_counter() - t0
-    disable_profiler()
     if sorted_key:
         print_report({"calls": "calls", "total": "total_s",
                       "ave": "avg_s", "max": "max_s"}.get(sorted_key,

@@ -50,6 +50,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability.metrics import MirroredCounters
+from ..observability.tracing import TRACER as _TRC
 from .kv_cache import PagedKVCache, page_size_from_env, pages_needed
 from .scheduler import (RUNNING, ContinuousBatchingScheduler,
                         PreemptiveScheduler, Request)
@@ -73,7 +75,8 @@ class ServingEngine:
                  chunk_size: Optional[int] = None,
                  chunk_lanes: Optional[int] = None,
                  watermark_pages: Optional[int] = None,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True,
+                 name: Optional[str] = None):
         """`lm` is a DecoderLM whose tower is already built (.logits())
         and whose parameters are initialized in the global scope (the
         startup program ran).  `num_pages` defaults to enough for every
@@ -85,7 +88,12 @@ class ServingEngine:
         max_prefill_per_step), `watermark_pages` free pages admission
         keeps for decode growth (default: sized from hbm_report() — the
         worst transient program peak expressed in pages),
-        `prefix_caching=False` disables the shared-page index."""
+        `prefix_caching=False` disables the shared-page index.
+        `name` labels this engine's metric series (default: the
+        scheduler mode — STABLE across engine re-creations, so a
+        process that rebuilds engines never grows the registry's
+        series cardinality; pass distinct names when running several
+        engines of one mode side by side)."""
         from .. import layers
         from ..framework import unique_name
         from ..framework.core import Program, np_dtype, program_guard
@@ -164,10 +172,21 @@ class ServingEngine:
         self._steps = 0
         # serving counters (bench + tests): prefill tokens actually
         # computed vs served from the prefix cache, COW copies run, and
-        # the peak stranded-reservation gauge the v1 path exposes
-        self.counters = {"prefill_computed": 0, "prefill_cached": 0,
-                         "cow_copies": 0, "peak_stranded": 0,
-                         "mixed_steps": 0, "decode_steps": 0}
+        # the peak stranded-reservation gauge the v1 path exposes.
+        # Dict API unchanged; writes are mirrored into the shared metrics
+        # registry (serve_counters{engine=...,scheduler=...,counter=...})
+        # so the telemetry snapshot sees the serving tier (ISSUE 13).
+        # The engine label defaults to the SCHEDULER MODE, not the
+        # unique serve_N prefix: a per-instance label would grow the
+        # family by 6 series per engine ever constructed and trip the
+        # cardinality guard in long-lived processes.
+        self.name = str(name) if name is not None else self.mode
+        self.counters = MirroredCounters(
+            {"prefill_computed": 0, "prefill_cached": 0,
+             "cow_copies": 0, "peak_stranded": 0,
+             "mixed_steps": 0, "decode_steps": 0},
+            family="serve_counters", engine=self.name,
+            scheduler=self.mode)
 
     # ------------------------------------------------------------------
     def _build_v2_programs(self):
@@ -297,33 +316,36 @@ class ServingEngine:
         # admit() can never return more than this many
         cap = min(self.scheduler.max_prefill_per_step, self.num_slots)
         for bucket, group in sorted(by_bucket.items()):
-            prog, fetch = self._prefill_program(bucket)
-            # pad to the next power of two <= cap: at most log2(cap)+1
-            # cached executables per bucket, without a multi-bucket wave
-            # paying cap-row tower forwards for every 1-request group
-            G = 1
-            while G < len(group):
-                G *= 2
-            G = min(G, cap)
-            toks = np.zeros((G, bucket, 1), np.int64)
-            plen = np.ones((G, 1), np.int64)
-            pts = np.zeros((G, self.max_pages), np.int64)
-            for i, r in enumerate(group):
-                toks[i, :len(r.prompt), 0] = r.prompt
-                plen[i, 0] = len(r.prompt)
-                pts[i] = self.cache.page_table[r.slot]
-            (first,) = self._exe.run(
-                prog,
-                feed={f"{self._pfx}.prompt{bucket}": toks,
-                      f"{self._pfx}.plen{bucket}": plen,
-                      f"{self._pfx}.ppt{bucket}": pts},
-                fetch_list=[fetch])
-            now = self._clock()
-            for i, r in enumerate(group):
-                r.ctx_len = len(r.prompt)
-                r.first_token_t = now
-                self.counters["prefill_computed"] += len(r.prompt)
-                self._record_token(r, int(np.asarray(first)[i]), now)
+            with _TRC.span("serve.prefill", bucket=bucket,
+                           requests=len(group)):
+                prog, fetch = self._prefill_program(bucket)
+                # pad to the next power of two <= cap: at most log2(cap)+1
+                # cached executables per bucket, without a multi-bucket
+                # wave paying cap-row tower forwards for every 1-request
+                # group
+                G = 1
+                while G < len(group):
+                    G *= 2
+                G = min(G, cap)
+                toks = np.zeros((G, bucket, 1), np.int64)
+                plen = np.ones((G, 1), np.int64)
+                pts = np.zeros((G, self.max_pages), np.int64)
+                for i, r in enumerate(group):
+                    toks[i, :len(r.prompt), 0] = r.prompt
+                    plen[i, 0] = len(r.prompt)
+                    pts[i] = self.cache.page_table[r.slot]
+                (first,) = self._exe.run(
+                    prog,
+                    feed={f"{self._pfx}.prompt{bucket}": toks,
+                          f"{self._pfx}.plen{bucket}": plen,
+                          f"{self._pfx}.ppt{bucket}": pts},
+                    fetch_list=[fetch])
+                now = self._clock()
+                for i, r in enumerate(group):
+                    r.ctx_len = len(r.prompt)
+                    r.first_token_t = now
+                    self.counters["prefill_computed"] += len(r.prompt)
+                    self._record_token(r, int(np.asarray(first)[i]), now)
 
     def _record_token(self, req: Request, token: int, now: float):
         req.generated.append(token)
@@ -336,26 +358,28 @@ class ServingEngine:
     def _decode(self):
         if not self.scheduler.active:
             return
-        N = self.num_slots
-        tok = np.zeros((N, 1), np.int64)
-        ctx = np.zeros((N, 1), np.int64)
-        act = np.zeros((N, 1), np.int64)
-        for slot, r in self.scheduler.active.items():
-            tok[slot, 0] = r.generated[-1]
-            ctx[slot, 0] = r.ctx_len
-            act[slot, 0] = 1
-        (nxt,) = self._exe.run(
-            self._decode_prog,
-            feed={f"{self._pfx}.tok": tok, f"{self._pfx}.ctx": ctx,
-                  f"{self._pfx}.act": act,
-                  f"{self._pfx}.pt": self.cache.page_table_i64()},
-            fetch_list=[self._decode_fetch])
-        nxt = np.asarray(nxt)
-        now = self._clock()
-        # snapshot: finish() mutates scheduler.active during the walk
-        for slot, r in list(self.scheduler.active.items()):
-            r.ctx_len += 1  # this step wrote r.generated[-1]'s K/V
-            self._record_token(r, int(nxt[slot]), now)
+        with _TRC.span("serve.decode",
+                       active=len(self.scheduler.active)):
+            N = self.num_slots
+            tok = np.zeros((N, 1), np.int64)
+            ctx = np.zeros((N, 1), np.int64)
+            act = np.zeros((N, 1), np.int64)
+            for slot, r in self.scheduler.active.items():
+                tok[slot, 0] = r.generated[-1]
+                ctx[slot, 0] = r.ctx_len
+                act[slot, 0] = 1
+            (nxt,) = self._exe.run(
+                self._decode_prog,
+                feed={f"{self._pfx}.tok": tok, f"{self._pfx}.ctx": ctx,
+                      f"{self._pfx}.act": act,
+                      f"{self._pfx}.pt": self.cache.page_table_i64()},
+                fetch_list=[self._decode_fetch])
+            nxt = np.asarray(nxt)
+            now = self._clock()
+            # snapshot: finish() mutates scheduler.active during the walk
+            for slot, r in list(self.scheduler.active.items()):
+                r.ctx_len += 1  # this step wrote r.generated[-1]'s K/V
+                self._record_token(r, int(nxt[slot]), now)
 
     # ------------------------------------------------------------------
     # v2: mixed chunked-prefill + decode step, COW copies, preemption
@@ -367,11 +391,14 @@ class ServingEngine:
         not recycle it out from under the pending copy); the pin is
         released here, once the content is duplicated."""
         for slot, src, dst in self.scheduler.pending_copies:
-            self._exe.run(
-                self._copy_prog,
-                feed={f"{self._pfx}.cp.src": np.array([[src]], np.int64),
-                      f"{self._pfx}.cp.dst": np.array([[dst]], np.int64)},
-                fetch_list=[self._copy_fetch])
+            with _TRC.span("serve.cow_copy", src=src, dst=dst):
+                self._exe.run(
+                    self._copy_prog,
+                    feed={f"{self._pfx}.cp.src":
+                          np.array([[src]], np.int64),
+                          f"{self._pfx}.cp.dst":
+                          np.array([[dst]], np.int64)},
+                    fetch_list=[self._copy_fetch])
             self.cache.allocator.free([src])
             self.counters["cow_copies"] += 1
         self.scheduler.pending_copies.clear()
@@ -388,7 +415,8 @@ class ServingEngine:
 
     def _step_v2(self) -> bool:
         now = self._clock()
-        self.scheduler.admit(now=now)
+        with _TRC.span("serve.admit", scheduler="v2") as sp:
+            sp.note(admitted=len(self.scheduler.admit(now=now)))
         self._run_copies()
 
         # on-demand decode growth BEFORE feeds are built: a slot about to
@@ -441,15 +469,20 @@ class ServingEngine:
             cclen[j, 0] = cl
             cpt[j] = self.cache.page_table[r.slot]
             chunk_of.append((r, cl))
-        (nxt, cnxt) = self._exe.run(
-            self._mixed_prog,
-            feed={f"{self._pfx}.m.tok": tok, f"{self._pfx}.m.ctx": ctx,
-                  f"{self._pfx}.m.act": act,
-                  f"{self._pfx}.m.pt": self.cache.page_table_i64(),
-                  f"{self._pfx}.m.ctok": ctok, f"{self._pfx}.m.cctx": cctx,
-                  f"{self._pfx}.m.cclen": cclen,
-                  f"{self._pfx}.m.cpt": cpt},
-            fetch_list=[self._mixed_decode_fetch, self._mixed_chunk_fetch])
+        with _TRC.span("serve.mixed_step", lanes=len(lanes),
+                       decoding=len(decoding)):
+            (nxt, cnxt) = self._exe.run(
+                self._mixed_prog,
+                feed={f"{self._pfx}.m.tok": tok,
+                      f"{self._pfx}.m.ctx": ctx,
+                      f"{self._pfx}.m.act": act,
+                      f"{self._pfx}.m.pt": self.cache.page_table_i64(),
+                      f"{self._pfx}.m.ctok": ctok,
+                      f"{self._pfx}.m.cctx": cctx,
+                      f"{self._pfx}.m.cclen": cclen,
+                      f"{self._pfx}.m.cpt": cpt},
+                fetch_list=[self._mixed_decode_fetch,
+                            self._mixed_chunk_fetch])
         nxt, cnxt = np.asarray(nxt), np.asarray(cnxt)
         now = self._clock()
         self.counters["mixed_steps"] += 1
@@ -484,15 +517,21 @@ class ServingEngine:
         if self.mode == "v2":
             alive = self._step_v2()
         else:
-            admitted = self.scheduler.admit(now=self._clock())
+            with _TRC.span("serve.admit", scheduler="fifo") as sp:
+                admitted = self.scheduler.admit(now=self._clock())
+                sp.note(admitted=len(admitted))
             if admitted:
                 self._prefill(admitted)
             self._decode()
             self._steps += 1
             alive = self.scheduler.outstanding() > 0
         stats = self.scheduler.page_stats()
-        if stats["stranded"] > self.counters["peak_stranded"]:
-            self.counters["peak_stranded"] = stats["stranded"]
+        # written EVERY step (not only on a new max): the registry
+        # mirror re-seeds on writes, so a monotone-max key updated only
+        # on improvement could stay missing from snapshots after a
+        # mid-life REGISTRY.reset()
+        self.counters["peak_stranded"] = max(
+            stats["stranded"], self.counters["peak_stranded"])
         return alive
 
     def run(self, max_steps: int = 100000) -> Dict[int, Request]:

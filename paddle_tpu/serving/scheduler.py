@@ -44,6 +44,7 @@ import itertools
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..observability.metrics import REGISTRY as _MET
 from .kv_cache import PagedKVCache, pages_needed
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
@@ -198,6 +199,9 @@ class ContinuousBatchingScheduler(_SchedulerBase):
             self.cache.assign(slot, pages)
             self.active[slot] = req
             self.admission_order.append(req.rid)
+            _MET.counter("serve_admissions_total",
+                         "requests placed into decode slots").inc(
+                scheduler="fifo")
             out.append(req)
         return out
 
@@ -247,7 +251,14 @@ class PreemptiveScheduler(_SchedulerBase):
         succeed."""
         short = need - self.cache.allocator.available()
         if short > 0:
-            self.cache.prefix.evict_pages(short)
+            evicted = self.cache.prefix.evict_pages(short)
+            if evicted:
+                # reclaim-ladder rung 1: prefix-LRU eviction (the
+                # cheapest lever — no running request is disturbed)
+                _MET.counter(
+                    "serve_reclaim_pages_total",
+                    "pages reclaimed, by ladder rung").inc(
+                    evicted, rung="prefix_evict")
         return self.cache.allocator.available() >= need
 
     def _victim(self, exclude: Optional[Request] = None,
@@ -265,12 +276,18 @@ class PreemptiveScheduler(_SchedulerBase):
                 best = (key, r)
         return best[1] if best else None
 
-    def preempt(self, req: Request, now: float = 0.0):
+    def preempt(self, req: Request, now: float = 0.0,
+                rung: str = "explicit"):
         """Evict-and-requeue: pages back to the pool (shared pages just
         drop this holder), generated tokens kept, position in line
-        restored by the original arrival stamp."""
+        restored by the original arrival stamp.  `rung` labels WHICH
+        ladder step evicted this request in the metrics registry
+        (admission_preempt | preempt_other | preempt_self | explicit)."""
         if req.state != RUNNING:
             raise ValueError(f"request {req.rid} is {req.state}")
+        _MET.counter("serve_preemptions_total",
+                     "requests evicted-and-requeued, by ladder rung").inc(
+            rung=rung)
         # drop any pending COW copy into the victim's row before its
         # pages return to the pool — the copy would otherwise run
         # against a page the allocator may have re-issued.  (admit()'s
@@ -327,7 +344,7 @@ class PreemptiveScheduler(_SchedulerBase):
                 victim = self._victim(below_priority=req.priority)
                 if victim is None:
                     break
-                self.preempt(victim, now=now)
+                self.preempt(victim, now=now, rung="admission_preempt")
                 continue  # re-pin via a fresh lookup next iteration
             if not self._reclaim(need) and partial is not None:
                 # the COW-source pin can itself make reclaim
@@ -367,6 +384,14 @@ class PreemptiveScheduler(_SchedulerBase):
             self.cache.assign(slot, row)
             self.active[slot] = req
             self.admission_order.append(req.rid)
+            _MET.counter("serve_admissions_total",
+                         "requests placed into decode slots").inc(
+                scheduler="v2")
+            if req.ctx_len:
+                _MET.counter(
+                    "serve_prefix_hit_tokens_total",
+                    "prompt tokens served from the prefix cache at "
+                    "admission").inc(req.ctx_len)
             out.append(req)
         return out
 
@@ -388,9 +413,9 @@ class PreemptiveScheduler(_SchedulerBase):
             # steals from an older or more important request
             victim = self._victim()
             if victim is None or victim is req:
-                self.preempt(req, now=now)
+                self.preempt(req, now=now, rung="preempt_self")
                 return False
-            self.preempt(victim, now=now)
+            self.preempt(victim, now=now, rung="preempt_other")
 
     def page_stats(self) -> dict:
         return {**super().page_stats(), "watermark": self.watermark_pages}

@@ -87,8 +87,6 @@ class V1Trainer:
     def time(self, num_batches: int = 5):
         """Reference `--job=time`: compile on the first batch, then time
         `num_batches` steps.  Returns (ms_per_batch, last_loss)."""
-        import time as _time
-
         prov, files = get_data_source("train")
         if prov is None:
             raise RuntimeError(
@@ -101,11 +99,13 @@ class V1Trainer:
             raise RuntimeError("train data source yielded no batches")
         (loss,) = self.exe.run(feed=feeds[0],
                                fetch_list=[self.cost_var])  # compile
+        from ..observability.metrics import monotime
+
         timed = feeds[1:] or feeds  # tiny dataset: re-time the only batch
-        t0 = _time.perf_counter()
+        t0 = monotime()
         for f in timed:
             (loss,) = self.exe.run(feed=f, fetch_list=[self.cost_var])
-        dt = (_time.perf_counter() - t0) / len(timed)
+        dt = (monotime() - t0) / len(timed)
         return dt * 1e3, float(np.asarray(loss).reshape(-1)[0])
 
     def test(self):

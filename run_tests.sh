@@ -22,6 +22,14 @@ JAX_PLATFORMS=cpu python tools/lint_smoke.py
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m paddle_tpu analyze --sharding > /dev/null
 
+# telemetry smoke (docs/observability.md ISSUE 13): a traced fit-a-line
+# train step through the unified telemetry layer — asserts the executor
+# phase spans exist, the Perfetto trace and metrics snapshot are
+# schema-valid, and the predicted-vs-measured ratios are sane (the
+# static-model error channel ROADMAP #3 consumes)
+env JAX_PLATFORMS=cpu python tools/pred_vs_measured.py --smoke > /dev/null \
+    || { echo "telemetry smoke failed (rc=$?)"; exit 1; }
+
 # chaos smoke (docs/distributed.md): one seeded worker-kill against the
 # elastic training service, recovery proved equivalent to the
 # uninterrupted reference by the PR 10 differential oracle — <30s, fails
@@ -41,12 +49,19 @@ env JAX_PLATFORMS=cpu python tools/cache_guard.py --attempts 3 -- \
 # compile-cache integrity layer in paddle_tpu/compiler.py fixed the
 # poisoned-entry crash class at the source)
 serve_progs=$(mktemp -d)
-trap 'rm -rf "$serve_progs"' EXIT
+serve_tele=$(mktemp -d)
+trap 'rm -rf "$serve_progs" "$serve_tele"' EXIT
+# telemetry artifacts land in their own dir: the program-lint loop below
+# globs $serve_progs/*.json and must only ever see programs
 env JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=1 \
     python tools/cache_guard.py --attempts 3 --fresh-dir "$serve_progs" -- \
     python tools/serve_bench.py --smoke \
-    --scheduler ab --save-programs "$serve_progs" > /dev/null \
+    --scheduler ab --save-programs "$serve_progs" \
+    --trace "$serve_tele/serve_trace.json" \
+    --metrics "$serve_tele/serve_metrics.json" > /dev/null \
     || { echo "serve smoke failed (rc=$?)"; exit 1; }
+# --smoke + --trace/--metrics also asserts the telemetry artifacts are
+# schema-valid and the disabled-telemetry overhead stays under 1%/step
 for p in "$serve_progs"/*.json; do
     JAX_PLATFORMS=cpu python -m paddle_tpu lint "$p" > /dev/null \
         || { echo "serving program lint failed: $p"; exit 1; }

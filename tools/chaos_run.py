@@ -40,9 +40,21 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", action="append", default=[],
                     help="explicit scenario(s) instead of the catalog")
     ap.add_argument("--out", default=None, help="artifact path")
+    ap.add_argument("--trace", default=None,
+                    help="write the Perfetto trace of the run (rollback "
+                         "spans, admission/recovery events, executor "
+                         "phases) to this path")
+    ap.add_argument("--metrics", default=None,
+                    help="write the metrics-registry snapshot JSON "
+                         "(recoveries, lease/requeue counters, "
+                         "admissions) to this path")
     args = ap.parse_args(argv)
 
+    from paddle_tpu import observability as obs
     from paddle_tpu.distributed import chaos
+
+    if args.trace:
+        obs.enable_tracing()
 
     t0 = time.time()
     if args.smoke:
@@ -82,12 +94,32 @@ def main(argv=None) -> int:
         "metric": "chaos_matrix_proven_cells",
         "value": proven,
         "cells": len(results),
-        "ok": ok,
+        # "ok" is assigned once, after the telemetry block may flip it
         "elapsed_s": round(time.time() - t0, 1),
         "scenarios": sorted({r["scenario"] for r in results}),
         "results": results,
         "admission_demo": admission,
     }
+    # telemetry artifacts: the chaos run's whole window through the
+    # shared registry/tracer (run_scenario never calls fluid.reset(), so
+    # the counters accumulate across cells)
+    if args.trace or args.metrics:
+        problems = obs.export_telemetry(
+            trace_obj=obs.TRACER.to_chrome() if args.trace else None,
+            trace_path=args.trace,
+            metrics_obj=obs.REGISTRY.snapshot() if args.metrics
+            else None,
+            metrics_path=args.metrics)
+        if problems:
+            print(f"# telemetry schema problems: {problems}",
+                  file=sys.stderr)
+            ok = False
+        if args.trace:
+            artifact["trace"] = args.trace
+        if args.metrics:
+            artifact["metrics"] = args.metrics
+    artifact["ok"] = ok
+
     line = json.dumps(artifact, default=str)
     print(line)
     if args.out:

@@ -42,6 +42,42 @@ PAUSE_STALE_S = 7200.0  # a pause file this old is a killed bench run's
                         # leftover, not an active stand-down request
 
 
+def _load_metrics_module():
+    """File-load observability/metrics.py WITHOUT importing paddle_tpu:
+    the daemon process must never drag jax (or a wedged TPU plugin) into
+    itself — that is the whole point of probing in subprocesses.  The
+    metrics module is deliberately stdlib-only to keep this loadable."""
+    import importlib.util
+
+    path = os.path.join(REPO, "paddle_tpu", "observability", "metrics.py")
+    spec = importlib.util.spec_from_file_location(
+        "evidence_daemon_metrics", path)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+_METRICS = _load_metrics_module()
+EVENTS = _METRICS.REGISTRY.counter(
+    "evidence_daemon_events_total",
+    "daemon state transitions (probe, capture_start/done, paused, "
+    "capture_given_up...) by event and outcome")
+
+
+def _dump_metrics():
+    """Publish the daemon's registry snapshot beside the probe log so a
+    round's state-transition history is queryable as metrics, not just
+    greppable as JSONL."""
+    path = os.path.join(OUT, "daemon_metrics.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(_METRICS.REGISTRY.snapshot(), f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def paused():
     try:
         age = time.time() - os.path.getmtime(PAUSE_PATH)
@@ -61,6 +97,13 @@ def log(rec):
     rec["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(os.path.join(OUT, "probe_log.jsonl"), "a") as f:
         f.write(json.dumps(rec) + "\n")
+    labels = {"event": str(rec.get("event", "unknown"))}
+    if "ok" in rec:
+        labels["ok"] = str(bool(rec["ok"])).lower()
+    if "name" in rec:
+        labels["name"] = str(rec["name"])
+    EVENTS.inc(**labels)
+    _dump_metrics()
     print(json.dumps(rec), flush=True)
 
 
@@ -194,8 +237,20 @@ CAPTURES = [
     # prefix-heavy workload, with the token-identity cross-check — the
     # first on-chip p99/tok-per-s comparison row and cache-hit fraction
     ("serve_v2",
-     [sys.executable, "tools/serve_bench.py", "--scheduler", "ab"],
+     [sys.executable, "tools/serve_bench.py", "--scheduler", "ab",
+      "--trace", os.path.join(OUT, "serve_v2_trace.json"),
+      "--metrics", os.path.join(OUT, "serve_v2_metrics.json")],
      {"SERVE_SLOTS": "64", "SERVE_REQUESTS": "96"}, 900),
+    # predicted-vs-measured on chip (ISSUE 13 / ROADMAP #3+#5): the
+    # static cost/memory model's error ratios for the book models and
+    # the small LM, measured against real step time and XLA's on-chip
+    # buffer assignment — the headline static-vs-measured number the
+    # next live window is supposed to publish
+    ("pred_vs_measured",
+     [sys.executable, "tools/pred_vs_measured.py",
+      "--trace", os.path.join(OUT, "pred_vs_measured_trace.json"),
+      "--metrics", os.path.join(OUT, "pred_vs_measured_metrics.json")],
+     {}, 580),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},
@@ -259,7 +314,9 @@ CAPTURES = [
     # the first on-chip proof that the recovery ladder is bit-exact on
     # real hardware, not just under the CPU mesh
     ("chaos_matrix",
-     [sys.executable, "tools/chaos_run.py", "--matrix", "--seeds", "2"],
+     [sys.executable, "tools/chaos_run.py", "--matrix", "--seeds", "2",
+      "--trace", os.path.join(OUT, "chaos_matrix_trace.json"),
+      "--metrics", os.path.join(OUT, "chaos_matrix_metrics.json")],
      {}, 1200),
     ("unet",
      [sys.executable, "bench.py"],

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Predicted-vs-measured accounting driver (ISSUE 13 / ROADMAP #3, #5).
+
+Runs train steps of the three standing calibration programs —
+fit-a-line, recognize-digits, and the small decoder LM — under the
+telemetry layer (paddle_tpu/observability/), with the static
+cost/memory predictions attached via ``accounting.track``, and emits ONE
+bench-schema JSON line whose rows are the predicted/measured error
+ratios:
+
+    predvmeas_step_ratio_<model>   predicted/measured step time
+    predvmeas_peak_ratio_<model>   predicted/measured HBM peak
+                                   (Executor.memory_stats, the PR 8
+                                   argument+temp formula)
+
+The chip spec defaults to the DETECTED backend (cpu-host on the CPU
+mesh), so a CPU run prices the roofline against the CPU's numbers: its
+step-time ratio measures dispatch overhead on microscopic models, not
+model error — the on-chip capture (evidence daemon: `pred_vs_measured`)
+is the number ROADMAP #3 tunes against.  Peak ratios are meaningful on
+both (XLA's buffer assignment is the same machinery).
+
+Flags:
+  --smoke       fit-a-line only + hard schema/series asserts — the
+                run_tests.sh fast-tier telemetry gate (traced step,
+                trace + snapshot linted)
+  --steps N     steady-state steps per model (default 8)
+  --out FILE    also write the artifact line to FILE
+  --trace FILE  write the Chrome/Perfetto trace of the whole run
+  --metrics FILE  write the registry snapshot JSON
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_fit_a_line():
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    rng = np.random.RandomState(0)
+    bs = 64
+    feed = {"x": rng.rand(bs, 13).astype(np.float32),
+            "y": rng.rand(bs, 1).astype(np.float32)}
+    return feed, [cost], bs
+
+
+def _build_recognize_digits():
+    import paddle_tpu as fluid
+
+    img = fluid.layers.data(name="img", shape=[1, 28, 28])
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                            bias_attr=False)
+    b = fluid.layers.batch_norm(c, act="relu")
+    p = fluid.layers.pool2d(b, pool_size=2, pool_stride=2)
+    flat = fluid.layers.reshape(p, [-1, 8 * 12 * 12])
+    pred = fluid.layers.fc(flat, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    rng = np.random.RandomState(1)
+    bs = 16
+    feed = {"img": rng.rand(bs, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (bs, 1)).astype(np.int64)}
+    return feed, [loss], bs
+
+
+def _build_small_lm():
+    from paddle_tpu.models import transformer
+
+    S, V = 32, 128
+    loss = transformer.build_lm_train_program(
+        seq_len=S, vocab_size=V, dim=32, n_layers=2, n_heads=2,
+        dtype="float32", learning_rate=1e-2)
+    rng = np.random.RandomState(2)
+    bs = 4
+    toks = rng.randint(0, V, (bs, S, 1)).astype(np.int64)
+    feed = {"tokens": toks, "targets": np.roll(toks, -1, axis=1)}
+    return feed, [loss], bs
+
+
+MODELS = (("fit_a_line", _build_fit_a_line),
+          ("recognize_digits", _build_recognize_digits),
+          ("small_lm", _build_small_lm))
+
+
+def run_model(name, builder, steps, chip):
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+
+    fluid.reset()  # NOTE: also resets the registry/tracer — see main()
+    feed, fetch, bs = builder()
+    program = fluid.default_main_program()
+    prediction = obs.accounting.track(program, name, batch_size=bs,
+                                      chip=chip)
+    exe = fluid.Executor(fluid.default_place())
+    exe.run(fluid.default_startup_program())
+    with obs.span("predvmeas.model", model=name):
+        for i in range(steps + 1):  # +1: the first run compiles
+            with obs.span("predvmeas.step", model=name, step=i):
+                exe.run(program, feed=feed, fetch_list=fetch,
+                        rng_step=i)
+        obs.accounting.record_measured_peak(program, exe, feed=feed,
+                                            fetch_list=fetch)
+    rows = obs.accounting.artifact_rows()
+    report = obs.accounting.report()
+    return prediction, rows, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fit-a-line only, with schema asserts (CI)")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--trace", default=None)
+    ap.add_argument("--metrics", default=None)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.analysis import cost as acost
+
+    chip = acost.detect_chip()
+    models = MODELS[:1] if args.smoke else MODELS
+    all_rows, reports = [], []
+    # fluid.reset() wipes telemetry between models, so each model's rows
+    # and trace window are collected right after its run; the snapshot
+    # export covers the LAST model's window (fit-a-line in --smoke)
+    windows = []
+    snapshot = None
+    for name, builder in models:
+        obs.enable_tracing()
+        _, rows, report = run_model(name, builder, args.steps, chip)
+        all_rows.extend(rows)
+        reports.extend(report)
+        windows.append(obs.TRACER.events())
+        snapshot = obs.REGISTRY.snapshot()
+
+    # each model ran in its own tracer window (fluid.reset() re-anchors
+    # ts at 0): shift the windows onto one sequential timeline
+    events = obs.concat_windows(windows)
+    by_name = {r["metric"]: r for r in all_rows}
+    headline = obs.artifact_metric(
+        "predvmeas_rows", len(all_rows), "rows", vs_baseline=0.0,
+        note=(f"predicted-vs-measured error ratios (predicted/measured; "
+              f"1.0 = perfect static model) for "
+              f"{', '.join(n for n, _ in models)} on chip spec "
+              f"{chip!r}; step ratios on cpu-host measure dispatch "
+              f"overhead on these microscopic models — the on-chip "
+              f"capture is the ROADMAP #3 calibration number"),
+        chip=chip, extra_metrics=all_rows, pred_vs_measured=reports)
+
+    trace_obj = obs.chrome_envelope(events)
+    problems = obs.export_telemetry(
+        trace_obj=trace_obj, trace_path=args.trace,
+        metrics_obj=snapshot, metrics_path=args.metrics)
+    if args.smoke:
+        # the run_tests.sh telemetry gate: a traced fit-a-line step must
+        # yield (a) a schema-valid Perfetto trace containing the
+        # executor phase spans, (b) a schema-valid registry snapshot
+        # carrying the predicted-vs-measured series, (c) finite ratios
+        assert not problems, f"telemetry artifact schema: {problems}"
+        assert not obs.validate_chrome_trace(trace_obj)
+        names = {e["name"] for e in events}
+        for want in ("executor.compile", "executor.execute",
+                     "executor.donate", "executor.writeback",
+                     "predvmeas.step"):
+            assert want in names, f"missing span {want}: {sorted(names)}"
+        assert snapshot is not None
+        sp = obs.validate_snapshot(snapshot)
+        assert not sp, f"snapshot schema: {sp}"
+        fams = snapshot["families"]
+        for fam in ("executor_step_seconds",
+                    "pred_vs_measured_step_time_ratio",
+                    "pred_vs_measured_peak_ratio",
+                    "executor_steps_total"):
+            assert fam in fams, f"missing family {fam}"
+        assert by_name["predvmeas_step_ratio_fit_a_line"]["value"] > 0
+        peak = by_name["predvmeas_peak_ratio_fit_a_line"]["value"]
+        assert 0.2 < peak < 5.0, f"peak ratio {peak} out of sanity band"
+        print("# telemetry smoke OK "
+              f"(peak ratio {peak}, {len(events)} trace events)",
+              file=sys.stderr)
+
+    if problems:
+        print(f"# telemetry schema problems: {problems}",
+              file=sys.stderr)
+
+    line = json.dumps(headline)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,18 @@ Three classes of rot this repo has actually accumulated:
      in the ``docs/analysis.md`` rule catalog (PTV001–024 were drifting
      apart by hand), and the docs must not carry rows for rules the
      verifier no longer registers.
-  7. checkpoint-directory writes outside ``distributed/checkpoint.py``
+  7. ad-hoc ``perf_counter()`` timing outside
+     ``paddle_tpu/observability/`` — ISSUE 13 unified the telemetry
+     substrate precisely because every tier had grown its own
+     ``time.perf_counter()`` bookkeeping (profiler.py's global event
+     map, serve_bench/bench.py private dicts); new timing goes through
+     ``observability.metrics.monotime`` / ``REGISTRY.timed()`` /
+     tracer spans so it lands in the shared registry.  Shim-listed
+     exemptions: the kernel/step microbench oracles whose timing IS
+     the product (tools/bench_kernels.py, tools/profile_resnet.py);
+     ``tests/`` are exempt as always.  Line-anchored tripwire like the
+     others, not an AST proof.
+  8. checkpoint-directory writes outside ``distributed/checkpoint.py``
      — the chaos suite's crash-recovery proof rests on every byte in a
      ``ckpt_<n>`` dir (and the LATEST pointer) being published by one
      audited tmp+rename path; an ``open(...ckpt..., "w")`` or
@@ -161,6 +172,55 @@ def _check_page_table(root, dirpath, filenames, findings):
             pass
 
 
+# the ad-hoc-timing guard: perf_counter (any alias form) outside the
+# observability package.  The pattern is assembled so this file does
+# not flag itself.
+_PERF_COUNTER_RE = re.compile(r"\bperf_" + r"counter\s*\(")
+_PERF_COUNTER_DIRS = ("paddle_tpu", "tools")
+_PERF_COUNTER_OK_DIR = os.path.join("paddle_tpu", "observability")
+# measurement oracles whose timing loop IS the deliverable: their
+# numbers feed artifacts directly and never mint registry metrics
+_PERF_COUNTER_OK = {
+    os.path.join("tools", "bench_kernels.py"),
+    os.path.join("tools", "profile_resnet.py"),
+}
+
+
+def _check_perf_counter(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    top = "" if rel_dir == "." else rel_dir.split(os.sep)[0]
+    if top and top not in _PERF_COUNTER_DIRS:
+        return
+    if rel_dir == _PERF_COUNTER_OK_DIR \
+            or rel_dir.startswith(_PERF_COUNTER_OK_DIR + os.sep):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel in _PERF_COUNTER_OK or rel == os.path.join(
+                "tools", "repo_lint.py"):
+            continue
+        # top-level scan covers bench.py; skip other root scripts that
+        # are not ours to police (none today, but the rule is scoped)
+        if top == "" and fname not in ("bench.py", "__graft_entry__.py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _PERF_COUNTER_RE.search(line):
+                        findings.append(
+                            f"ad-hoc perf_counter timing: {rel}:{i} "
+                            f"(use observability.metrics.monotime / "
+                            f"REGISTRY.timed() / tracer spans so the "
+                            f"measurement lands in the shared "
+                            f"registry; oracles may be shim-listed in "
+                            f"repo_lint._PERF_COUNTER_OK)")
+        except OSError:
+            pass
+
+
 # the atomic-checkpoint guard: a write-mode open / np.save on a line
 # that names a checkpoint path literal (ckpt_ staging dirs, the LATEST
 # pointer) anywhere under paddle_tpu/ or tools/ except the one audited
@@ -281,6 +341,7 @@ def lint(root: str):
         _check_compiler_params(root, dirpath, filenames, findings)
         _check_partition_spec(root, dirpath, filenames, findings)
         _check_page_table(root, dirpath, filenames, findings)
+        _check_perf_counter(root, dirpath, filenames, findings)
         _check_ckpt_writes(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
