@@ -61,6 +61,7 @@ from .lod import LoDTensor  # noqa: F401
 Tensor = LoDTensor  # reference fluid alias (__init__.py Tensor)
 from . import analysis  # noqa: F401  (program verifier: fluid.analysis.verify_program)
 from . import observability  # noqa: F401  (metrics registry + step tracing)
+from . import autotune  # noqa: F401  (analyzer-guided tuner; import-light)
 from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler, fuse_batch_norm  # noqa: F401
 from .framework import initializer  # noqa: F401

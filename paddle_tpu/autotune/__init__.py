@@ -1,0 +1,34 @@
+"""Analyzer-guided autotuner (ISSUE 14, ROADMAP #3).
+
+The TVM-style loop over this framework's own static analyzers: a typed
+search space (kernel block sizes, implementation variants, remat, XLA
+flags), the PR 8/9 cost/HBM analyzers as the ranking prior so only the
+predicted-top-k candidates ever compile, a timed measurement harness
+with PR 13 telemetry, and a persistent winner store the kernels and the
+executor read back transparently.
+
+Entry points:
+
+  * ``paddle tune <workload|saved-model-dir>`` (cli.py)
+  * :func:`tune` — the library face
+  * :mod:`paddle_tpu.autotune.knobs` — where kernels resolve tuning
+    parameters (trial override > env > winner store > default)
+
+This module stays import-light: the heavy pieces (workloads build real
+programs) load on first use.
+"""
+
+from __future__ import annotations
+
+from . import knobs, store  # noqa: F401  (import-light)
+from .store import WinnerStore, default_store  # noqa: F401
+
+
+def tune(workload, **kw):
+    """Tune a workload object or a registered workload name; see
+    autotune.tuner.tune for the knobs."""
+    from . import tuner, workloads
+
+    if isinstance(workload, str):
+        workload = workloads.get_workload(workload)
+    return tuner.tune(workload, **kw)

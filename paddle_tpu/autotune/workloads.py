@@ -1,0 +1,575 @@
+"""Tunable workloads: what `paddle tune` can point the harness at.
+
+Two shapes:
+
+  * :class:`ProgramWorkload` — a ProgramDesc train/infer step built into
+    a PRIVATE program pair (``program_guard`` + ``unique_name.guard`` so
+    repeated builds are name-deterministic — the program digest must be
+    stable — and the process's default program/telemetry are never
+    touched).  The ``remat`` axis applies the desc-level blanket
+    rematerialization pass to the built program, which is exactly what
+    the executor's winner pickup (integration.py) re-applies later.
+  * :class:`BnConvWorkload` — a kernel microbench (the bn-conv 3x3
+    variant A/B of the >=1.0x-or-delete contract): candidates select the
+    implementation variant, the runner asserts parity against the jnp
+    reference BEFORE timing (a fast wrong kernel must never win).
+
+Named registry at the bottom (``WORKLOADS``) — the `paddle tune MODEL`
+vocabulary, plus :func:`saved_model_workload` for arbitrary saved dirs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import space as _space
+from . import store as _store
+
+
+class Built:
+    """One candidate's built program + synthetic feed."""
+
+    __slots__ = ("main", "startup", "feed", "fetch", "batch_size")
+
+    def __init__(self, main, startup, feed, fetch, batch_size):
+        self.main = main
+        self.startup = startup
+        self.feed = feed
+        self.fetch = fetch
+        self.batch_size = batch_size
+
+
+class _ProgramRunner:
+    """Measurement runner with the bench.py `_timed_loop` staging
+    discipline: feed staged to the device ONCE (the compute-path
+    number), state donated by the executor, completion by value fetch."""
+
+    def __init__(self, built: Built):
+        import jax
+
+        import paddle_tpu as fluid
+        from ..framework.scope import Scope
+
+        self.built = built
+        self.scope = Scope()
+        self.exe = fluid.Executor(fluid.default_place())
+        self.exe.run(built.startup, scope=self.scope)
+        dev = self.exe.place.jax_device()
+        self.feed = {k: jax.device_put(np.asarray(v), dev)
+                     for k, v in built.feed.items()}
+        self._last = None
+        self._barrier_name = None  # fetch-less programs: set by owner
+
+    def step(self):
+        outs = self.exe.run(
+            self.built.main, feed=self.feed,
+            fetch_list=self.built.fetch, scope=self.scope,
+            return_numpy=False)
+        self._last = outs[0] if outs else None
+
+    def barrier(self):
+        # value fetch, not block_until_ready: the only wait a degraded
+        # transport must honor (the r4 bench lesson).  A fetch-less
+        # train program (every sink a state write) barriers on a
+        # written-back state buffer instead.
+        v = self._last
+        if v is None and self._barrier_name:
+            v = self.scope.find(self._barrier_name)
+        if v is not None:
+            np.asarray(v).ravel()[:1]
+
+    def close(self):
+        self.exe.close()
+
+
+class ProgramWorkload:
+    """A named ProgramDesc workload.  `builder()` runs inside fresh
+    program/name guards and returns (feed, fetch_list, batch_size)."""
+
+    kind = "program"
+
+    def __init__(self, name: str, builder: Callable,
+                 space_builder: Callable[[], _space.SearchSpace],
+                 kernel_sites: Tuple = (),
+                 flash_profile: Optional[dict] = None):
+        self.name = name
+        self._builder = builder
+        self._space_builder = space_builder
+        self._kernel_sites = tuple(kernel_sites)
+        self._flash = flash_profile
+        self._default_built: Optional[Built] = None
+
+    # -- space / identity ----------------------------------------------
+    def space(self) -> _space.SearchSpace:
+        return self._space_builder()
+
+    def build(self, candidate: Optional[_space.Candidate]) -> Built:
+        from ..framework import unique_name
+        from ..framework.core import Program, program_guard
+
+        main, startup = Program(), Program()
+        with unique_name.guard(), program_guard(main, startup):
+            feed, fetch, bs = self._builder()
+        built = Built(main, startup, feed, fetch, bs)
+        if candidate is not None and candidate.get("remat"):
+            from ..memory_optimization_transpiler import memory_optimize
+
+            memory_optimize(main, level=1, batch_size=bs)
+        return built
+
+    def _default(self) -> Built:
+        if self._default_built is None:
+            self._default_built = self.build(None)
+        return self._default_built
+
+    def site(self) -> dict:
+        """The store site: program digest of the DEFAULT build + the
+        feed signature — the compile-cache key shape (integration.py
+        computes the identical site from a live Executor.run)."""
+        from .integration import program_site
+
+        b = self._default()
+        return program_site(b.main, b.feed)
+
+    def kernel_sites(self) -> Tuple:
+        return self._kernel_sites
+
+    # -- prior hooks -----------------------------------------------------
+    def program_for(self, candidate) -> Tuple[object, int]:
+        b = self.build(candidate)
+        return b.main, b.batch_size
+
+    def byte_delta(self, candidate, spec) -> float:
+        """Extra HBM bytes the candidate's kernel parameters imply over
+        the registered op cost — the flash-attention K/V re-read model:
+        each q block re-reads the whole K and V (forward and the dq
+        backward pass), each k block re-reads Q/dO (dkv pass); causal
+        clamping halves the walk.  Coarse, but monotone in the block
+        sizes — all a ranking prior needs."""
+        if not self._flash:
+            return 0.0
+        bq = candidate.get("flash_attention.block_q")
+        bk = candidate.get("flash_attention.block_k")
+        if not bq or not bk:
+            return 0.0
+        p = self._flash
+        T, D = p["T"], p["head_dim"]
+        rows = p["layers"] * p["batch"] * p["heads"]
+        walk = 2.0 * T * D * p["dtype_bytes"]  # one full K+V (or Q+dO)
+        extra = rows * walk * (2.0 * max(T // int(bq) - 1, 0)
+                               + max(T // int(bk) - 1, 0))
+        if p.get("causal"):
+            extra *= 0.5
+        if candidate.get("remat"):
+            extra *= 1.5  # the recomputed forward repeats the walk
+        return extra
+
+    def feasible(self, candidate, spec) -> Tuple[bool, str]:
+        """Pre-compile legality beyond the HBM estimator: flash block
+        VMEM residency must fit the ~16 MiB core VMEM with headroom.
+        The binding pass is the dkv backward — it holds q and dO blocks
+        (bq·D each), k and v blocks (bk·D each) AND two f32 accumulator
+        scratches (bk·D each); the forward (q + k + v + one f32 acc) is
+        strictly lighter."""
+        if not self._flash:
+            return True, ""
+        bq = candidate.get("flash_attention.block_q")
+        bk = candidate.get("flash_attention.block_k")
+        if not bq or not bk:
+            return True, ""
+        D = self._flash["head_dim"]
+        b = self._flash["dtype_bytes"]
+        fwd = (int(bq) * D * (b + 4)       # q block + f32 acc scratch
+               + 2 * int(bk) * D * b       # k + v blocks
+               + 3 * int(bq) * 4)          # m/l scratch + lse row slice
+        bwd = (2 * int(bq) * D * b         # q + dO blocks
+               + 2 * int(bk) * D * b       # k + v blocks
+               + 2 * int(bk) * D * 4       # dk/dv f32 accumulators
+               + 2 * int(bq) * 4)          # lse + delta row slices
+        vmem = max(fwd, bwd)
+        budget = 0.75 * 16 * 1024 * 1024
+        if vmem > budget:
+            return False, (f"flash blocks bq={bq},bk={bk} need "
+                           f"{vmem} B VMEM > {int(budget)} budget")
+        return True, ""
+
+    # -- measurement -----------------------------------------------------
+    def build_runner(self, candidate) -> _ProgramRunner:
+        return _ProgramRunner(self.build(candidate))
+
+
+# ---------------------------------------------------------------------------
+# named program builders
+
+
+def _build_gpt_small():
+    """Small decoder-LM train step (the gpt-small attention workload):
+    T=256 admits two legal flash block sizes, so the block axes have
+    real content on TPU; float32 keeps the CPU A/B exact."""
+    import paddle_tpu as fluid
+    from ..models import transformer
+
+    T, V, dim, heads, layers = 256, 512, 64, 2, 2
+    bs = 2
+    loss = transformer.build_lm_train_program(
+        seq_len=T, vocab_size=V, dim=dim, n_layers=layers,
+        n_heads=heads, dtype="float32", learning_rate=1e-3)
+    rng = np.random.RandomState(7)
+    toks = rng.randint(0, V, (bs, T, 1)).astype(np.int64)
+    feed = {"tokens": toks, "targets": np.roll(toks, -1, axis=1)}
+    return feed, [loss], bs
+
+
+def _gpt_small_space():
+    return _space.flash_space(T=256, remat=True, xla_flags=_flag_menu())
+
+
+def _flag_menu():
+    """The curated XLA-flag axis: real choices only on TPU — a flag
+    candidate needs a fresh-process trial (flags bind at backend init),
+    and the curated set is TPU-specific."""
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            return _space.TPU_XLA_FLAG_CHOICES
+    except Exception:
+        pass
+    return ("",)
+
+
+def _build_lstm():
+    """The bench lstm shape scaled to CPU: 2xLSTM+fc classification —
+    the 6.97-vs-9.89 ms discrepancy's program family (ROADMAP #3 /
+    VERDICT r5 Weak #2), tuned + accounted so the harness, not a
+    human, owns its step time."""
+    import paddle_tpu as fluid
+    from ..models import image_models
+
+    bs, hidden, seq = 8, 128, 32
+    words = fluid.layers.sequence_data(name="words", shape=[1],
+                                       dtype="int64", max_len=seq)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.sequence_embedding(words, size=[1000, hidden],
+                                          dtype="float32")
+    logits = image_models.stacked_lstm_net(emb, hidden_dim=hidden,
+                                           stacked_num=2, class_dim=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.002).minimize(loss)
+    rng = np.random.RandomState(11)
+    feed = {"words": rng.randint(0, 1000, (bs, seq, 1)).astype(np.int64),
+            "words@LENGTH": np.full((bs,), seq, dtype=np.int32),
+            "label": rng.randint(0, 2, (bs, 1)).astype(np.int64)}
+    return feed, [loss], bs
+
+
+def _lstm_space():
+    return _space.remat_space(xla_flags=_flag_menu())
+
+
+# ---------------------------------------------------------------------------
+# bn-conv kernel workload (the v2 >=1.0x-or-delete contract, executed)
+
+
+class _KernelRunner:
+    def __init__(self, fn, args):
+        import jax
+
+        self._fn = jax.jit(fn)
+        self._args = args
+        self._last = None
+
+    def step(self):
+        self._last = self._fn(*self._args)
+
+    def barrier(self):
+        if self._last is not None:
+            np.asarray(self._last).ravel()[:1]
+
+    def close(self):
+        pass
+
+
+class BnConvWorkload:
+    """bn(+act)+conv3x3 forward variants (v1 whole-image / v2 O-blocked
+    / unfused reference) on one fixed training-shape tile.  On CPU the
+    Pallas variants run in interpret mode — parity there is the
+    correctness half of the r5 contract; the timing half that DECIDES
+    v1-vs-v2 is the on-chip `autotune_sweep`/`kernels_bnconv_v2`
+    capture (interpret-mode timing measures the interpreter)."""
+
+    kind = "kernel"
+    name = "bn_conv"
+
+    def __init__(self, N=2, H=8, W=8, K=128, O=256):
+        self.shape = (N, H, W, K, O)
+
+    def space(self) -> _space.SearchSpace:
+        return _space.bn_conv_space(O=self.shape[4])
+
+    def site(self) -> dict:
+        N, H, W, K, O = self.shape
+        return {"workload": self.name,
+                "x": [N, H, W, K], "w": [3, 3, K, O],
+                "dtype": "float32"}
+
+    def kernel_sites(self) -> Tuple:
+        return (("bn_conv", {}, {"variant": "bn_conv.variant",
+                                 "block_o": "bn_conv.block_o"}),)
+
+    def program_for(self, candidate):
+        return None  # kernel workload: priced analytically
+
+    def analytic_cost(self, candidate, spec) -> dict:
+        """Static FLOPs/bytes per variant.  The byte model gives v1 its
+        per-image weight re-fetch, v2 one weight pass, and the reference
+        the materialized normalized activation (write + read back) — the
+        fusion the kernels exist to delete.  Pallas pipelining quality
+        (the thing v2 actually changes) is NOT static-priceable; equal-
+        byte candidates tie in the prior and the measurement decides."""
+        N, H, W, K, O = self.shape
+        b = 4  # float32
+        x_bytes = N * H * W * K * b
+        w_bytes = 9 * K * O * b
+        o_bytes = N * H * W * O * b
+        flops = 2 * N * H * W * O * K * 9 + 6 * N * H * W * K
+        variant = candidate.get("bn_conv.variant", "v1")
+        if variant == "v1":
+            bytes_ = x_bytes + N * w_bytes + o_bytes
+        elif variant == "v2":
+            bytes_ = x_bytes + w_bytes + o_bytes
+        else:  # reference: normalized map hits HBM both ways
+            bytes_ = 3 * x_bytes + w_bytes + o_bytes
+        return {"flops": flops, "bytes": bytes_}
+
+    def feasible(self, candidate, spec):
+        return True, ""
+
+    def _args(self):
+        import jax.numpy as jnp
+
+        N, H, W, K, O = self.shape
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(N, H, W, K).astype(np.float32))
+        w = jnp.asarray(rng.randn(O, K, 3, 3).astype(np.float32) * 0.05)
+        g = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+        be = jnp.asarray(rng.randn(K).astype(np.float32))
+        mu = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
+        var = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+        return x, g, be, mu, var, w
+
+    def build_runner(self, candidate) -> _KernelRunner:
+        import jax
+
+        from ..ops.pallas_kernels import bn_conv as bc
+
+        x, g, be, mu, var, w = self._args()
+        interpret = jax.default_backend() != "tpu"
+        # the variant under test comes from the ACTIVE TRIAL OVERRIDE —
+        # the same resolution path production traces use, so this A/B
+        # proves the routing, not just the kernels
+        fn = bc.make_bn_conv3x3_train(act="relu", has_residual=False,
+                                      stride=1, interpret=interpret)
+        args = (x, g, be, mu, var, bc._w_hwio(w))
+        # parity gate before any timing: CPU interpret parity is the
+        # correctness half of the v2 contract
+        ref = bc.bn_conv3x3_reference(x, g, be, mu, var, w)
+        got = fn(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+        return _KernelRunner(fn, args)
+
+
+class PagedDecodeWorkload:
+    """Paged-attention decode kernel over KV page-size choices — the
+    tile axis of the serving tier (the page size is both the Pallas
+    kernel's K/V block and the allocator's granularity).  The candidate
+    page size reshapes the pools, so each trial builds its own args;
+    parity vs the pure-JAX reference gates every trial.  The winner
+    lands under the ("paged_attention", {}) site that
+    `knobs.paged_page_size` — and through it `ServingEngine`'s default
+    — resolves."""
+
+    kind = "kernel"
+    name = "paged_decode"
+
+    def __init__(self, N=4, nh=2, dh=16, max_ctx=128):
+        self.N, self.nh, self.dh, self.max_ctx = N, nh, dh, max_ctx
+
+    def space(self) -> _space.SearchSpace:
+        return _space.paged_space(max_ctx=self.max_ctx)
+
+    def site(self) -> dict:
+        return {"workload": self.name, "n": self.N, "heads": self.nh,
+                "head_dim": self.dh, "max_ctx": self.max_ctx,
+                "dtype": "float32"}
+
+    def kernel_sites(self) -> Tuple:
+        return (("paged_attention", {},
+                 {"page_size": "paged_attention.page_size"}),)
+
+    def program_for(self, candidate):
+        return None
+
+    def analytic_cost(self, candidate, spec) -> dict:
+        """Bytes walked per decode step: q + out + every mapped page of
+        K and V (the clamped walk re-fetches, never over-fetches) —
+        page size moves grid geometry, not byte volume, so candidates
+        tie in the prior and the measurement decides."""
+        b = 4
+        q = self.N * self.nh * self.dh * b
+        kv = 2 * self.N * self.max_ctx * self.nh * self.dh * b
+        flops = 4 * self.N * self.nh * self.max_ctx * self.dh
+        return {"flops": flops, "bytes": q + kv + q}
+
+    def feasible(self, candidate, spec):
+        return True, ""
+
+    def build_runner(self, candidate) -> _KernelRunner:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas_kernels import paged_attention as pa
+        from ..serving.kv_cache import pages_needed
+
+        ps = int(candidate.get("paged_attention.page_size", 16))
+        N, nh, dh, ctx = self.N, self.nh, self.dh, self.max_ctx
+        maxp = pages_needed(ctx, ps)
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(N, nh, dh).astype(np.float32))
+        num_pages = 1 + N * maxp  # page 0 = the reserved null page
+        k_pages = jnp.asarray(
+            rng.randn(num_pages, nh, ps, dh).astype(np.float32))
+        v_pages = jnp.asarray(
+            rng.randn(num_pages, nh, ps, dh).astype(np.float32))
+        pt = jnp.asarray(
+            (1 + np.arange(N * maxp)).reshape(N, maxp).astype(np.int32))
+        cl = jnp.asarray(
+            rng.randint(ps, ctx + 1, (N,)).astype(np.int32))
+        interpret = jax.default_backend() != "tpu"
+        fn = (lambda *a: pa.paged_attention(*a, interpret=True)) \
+            if interpret else pa.paged_attention
+        ref = pa.paged_attention_ref(q, k_pages, v_pages, pt, cl)
+        got = fn(q, k_pages, v_pages, pt, cl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+        return _KernelRunner(fn, (q, k_pages, v_pages, pt, cl))
+
+
+# ---------------------------------------------------------------------------
+# saved-model workloads (`paddle tune <dir>`)
+
+
+class SavedModelWorkload(ProgramWorkload):
+    """Generic workload over a saved model: remat/flag axes only (the
+    kernel knobs resolve per-site from whatever the program traces).
+    Feeds are the equivalence oracle's deterministic synthetic feeds;
+    state comes from the saved persistables when present and is
+    otherwise seeded by name — the differential-oracle idiom the
+    `metrics`/`trace` CLI runs already use."""
+
+    def __init__(self, path: str, batch_size: int = 2):
+        import os
+
+        from ..analysis import equivalence as eqv
+        from ..cli import _load_program_any
+
+        name = os.path.basename(os.path.normpath(path)) or "model"
+        super().__init__(name, builder=None,
+                         space_builder=_space.remat_space)
+        self.path = path
+        self.batch_size = batch_size
+        program, feed_names, fetch_names = _load_program_any(path)
+        block = program.global_block()
+        if not fetch_names:  # None OR an empty manifest list
+            fetch_names = eqv.sink_outputs(block)
+        if not feed_names:
+            feed_names = [v.name for v in block.vars.values()
+                          if v.is_data]
+        self._program_json = program.to_json()
+        self._fetch = list(fetch_names)
+        self._feeds = eqv.build_feeds(program, feed_names,
+                                      batch_size=batch_size)
+
+    def build(self, candidate) -> Built:
+        from ..framework.core import Program
+
+        main = Program.from_json(self._program_json)
+        built = Built(main, Program(), dict(self._feeds),
+                      list(self._fetch), self.batch_size)
+        if candidate is not None and candidate.get("remat"):
+            from ..memory_optimization_transpiler import memory_optimize
+
+            memory_optimize(main, level=1, batch_size=self.batch_size)
+        return built
+
+    def build_runner(self, candidate) -> _ProgramRunner:
+        from ..analysis import equivalence as eqv
+        from ..analysis.dataflow import state_classes
+        from ..cli import _load_scope_for
+
+        built = self.build(candidate)
+        runner = _ProgramRunner.__new__(_ProgramRunner)
+        import jax
+
+        import paddle_tpu as fluid
+        from ..framework.scope import Scope
+
+        runner.built = built
+        runner.scope = _load_scope_for(self.path) or Scope()
+        blk = built.main.global_block()
+        ext, rw, _ = state_classes(blk, list(built.feed))
+        for n in list(ext) + list(rw):
+            if runner.scope.find(n) is not None:
+                continue
+            dv = blk._find_var_recursive(n)
+            if dv is not None and dv.shape is not None:
+                runner.scope.set(n, eqv._seed_array(
+                    n, eqv._bind(dv.shape, self.batch_size),
+                    dv.dtype or "float32", 0))
+        runner.exe = fluid.Executor(fluid.default_place())
+        dev = runner.exe.place.jax_device()
+        runner.feed = {k: jax.device_put(np.asarray(v), dev)
+                       for k, v in built.feed.items()}
+        runner._last = None
+        runner._barrier_name = rw[0] if rw else (ext[0] if ext else None)
+        return runner
+
+
+def saved_model_workload(path: str, batch_size: int = 2
+                         ) -> SavedModelWorkload:
+    return SavedModelWorkload(path, batch_size)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+WORKLOADS: Dict[str, Callable[[], object]] = {
+    "gpt_small": lambda: ProgramWorkload(
+        "gpt_small", _build_gpt_small, _gpt_small_space,
+        kernel_sites=(("flash_attention", {"T": 256},
+                       {"block_q": "flash_attention.block_q",
+                        "block_k": "flash_attention.block_k"}),),
+        flash_profile={"T": 256, "head_dim": 32, "heads": 2, "batch": 2,
+                       "layers": 2, "causal": True, "dtype_bytes": 4}),
+    "bn_conv": BnConvWorkload,
+    "paged_decode": PagedDecodeWorkload,
+    "lstm": lambda: ProgramWorkload("lstm", _build_lstm, _lstm_space),
+}
+
+
+def get_workload(name: str):
+    """Named workload, or a saved-model workload when `name` is a
+    path."""
+    import os
+
+    if name in WORKLOADS:
+        return WORKLOADS[name]()
+    if os.path.exists(name):
+        return saved_model_workload(name)
+    raise KeyError(
+        f"unknown workload {name!r}: use one of {sorted(WORKLOADS)} or "
+        f"a saved-model path")

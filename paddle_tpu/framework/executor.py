@@ -353,6 +353,18 @@ class Executor:
         block = program.blocks[block_id]
         feed_vals = self._prepare_feeds(block, feed)
 
+        # autotune winner pickup (autotune/integration.py): a persisted
+        # `paddle tune` winner for this exact (program digest, feed
+        # signature, device, backend) re-applies its program-level
+        # decisions (attrs-only remat marks) BEFORE the cache key is
+        # computed, so the tuned executable is what gets cached.  One
+        # memoized lookup per program version; an empty store is a
+        # single scandir; PADDLE_TPU_AUTOTUNE=0 disables.
+        if block_id == 0:
+            from ..autotune.integration import maybe_apply_program_winner
+
+            maybe_apply_program_winner(program, feed_vals)
+
         key = self._cache_key(program, block_id, feed_vals, fetch_names)
         # the load-file signature lives beside the entry, not in the key: a
         # rewritten load file must *replace* the stale executable, not leak

@@ -158,12 +158,14 @@ def _fwd_kernel_v2_res(x_ref, params_ref, w_ref, r_ref, out_ref, apad_sc,
 
 
 def _v2_block_o(O: int) -> int:
-    """Weight O-block: PADDLE_TPU_BNCONV_BO override, else the largest
-    128-multiple divisor of O at or under 256 (>=2 grid steps when O
-    allows, so the weight-DMA/GEMM overlap actually exists)."""
-    import os
+    """Weight O-block: explicit override via the autotune knob layer
+    (trial override > PADDLE_TPU_BNCONV_BO, validated > stored winner),
+    else the largest 128-multiple divisor of O at or under 256 (>=2
+    grid steps when O allows, so the weight-DMA/GEMM overlap actually
+    exists)."""
+    from ...autotune import knobs
 
-    explicit = int(os.environ.get("PADDLE_TPU_BNCONV_BO", "0"))
+    explicit = knobs.bnconv_block_o()
     if explicit and O % explicit == 0:
         return explicit
     if O % 128:
@@ -446,18 +448,45 @@ def make_bn_conv3x3_train(act="relu", eps=1e-5, has_residual=False,
                           stride=1, interpret=False):
     """custom_vjp fused bn(+residual)+act+conv3x3 for training
     (generic_grad's jax.vjp honors it).  Takes HWIO weights; memoized
-    per config.  PADDLE_TPU_BNCONV_V2=1 routes the forward through the
-    O-blocked pipelined grid (bn_conv3x3_fwd_v2) — the r5 A/B knob."""
-    import os
+    per config.
 
-    use_v2 = os.environ.get("PADDLE_TPU_BNCONV_V2") == "1"
-    key = (act, eps, has_residual, stride, interpret, use_v2)
+    The forward implementation is a TUNABLE VARIANT resolved through
+    the autotune knob layer (trial override > PADDLE_TPU_BNCONV_VARIANT
+    / legacy PADDLE_TPU_BNCONV_V2=1 > stored winner > "v1"): "v1" is
+    the whole-image nine-tap kernel, "v2" the O-blocked pipelined grid
+    (the r5 attempt, now a first-class search-space member under the
+    >=1.0x-or-delete contract — `paddle tune bn_conv` decides it per
+    device from measurement), and "reference" the unfused jnp path (the
+    demotion arm of the contract, selectable without deleting the
+    kernels)."""
+    from ...autotune import knobs
+
+    variant = knobs.bnconv_variant()
+    key = (act, eps, has_residual, stride, interpret, variant)
     cached = _TRAIN_CACHE.get(key)
     if cached is not None:
         return cached
     import jax
 
-    fwd_impl = bn_conv3x3_fwd_v2 if use_v2 else bn_conv3x3_fwd
+    if variant == "reference":
+        # unfused semantics with jax's own autodiff — no custom_vjp
+        # needed, and w arrives HWIO like the kernel wrappers
+        if has_residual:
+            def f(x, gamma, beta, mean, var, w_hwio, r):
+                return bn_conv3x3_reference(
+                    x, gamma, beta, mean, var,
+                    w_hwio.transpose(3, 2, 0, 1), r=r, act=act, eps=eps,
+                    stride=stride)
+        else:
+            def f(x, gamma, beta, mean, var, w_hwio):
+                return bn_conv3x3_reference(
+                    x, gamma, beta, mean, var,
+                    w_hwio.transpose(3, 2, 0, 1), act=act, eps=eps,
+                    stride=stride)
+        _TRAIN_CACHE[key] = f
+        return f
+
+    fwd_impl = bn_conv3x3_fwd_v2 if variant == "v2" else bn_conv3x3_fwd
 
     if has_residual:
         @jax.custom_vjp

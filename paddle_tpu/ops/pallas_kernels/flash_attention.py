@@ -61,14 +61,18 @@ def _snap_blocks(block_q: int, block_k: int, T: int,
     Interpret mode has no Mosaic tile contract (tests run tiny T/blocks
     there), so it keeps plain largest-divisor snapping.
 
-    PADDLE_TPU_FLASH_BQ / PADDLE_TPU_FLASH_BK override the requested
-    blocks process-wide — the block-size sweep knob (read at trace time;
-    sweep runs use a fresh process per point, as make_flash_train's
-    memoization keys on the ARGUMENT blocks, not the env)."""
-    import os
+    The requested blocks resolve through the autotune knob layer
+    (paddle_tpu/autotune/knobs.py) at trace time: an active tuning
+    trial's override first, then the PADDLE_TPU_FLASH_BQ/BK env vars
+    (now VALIDATED — garbage raises a clear error instead of an
+    int() traceback, and the values are still clamped to legal aligned
+    divisors below), then the persisted winner for this sequence
+    length, then the argument defaults.  Winner pickup means a
+    `paddle tune` result configures every later trace with no env
+    plumbing; the env vars remain the explicit operator override."""
+    from ...autotune import knobs
 
-    block_q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", block_q))
-    block_k = int(os.environ.get("PADDLE_TPU_FLASH_BK", block_k))
+    block_q, block_k = knobs.flash_blocks(block_q, block_k, T)
     tile = 1 if interpret else 128
     bq = _snap_block(block_q, T, tile)
     bk = _snap_block(block_k, T, tile)

@@ -33,14 +33,17 @@ from typing import Dict, List, Optional, Tuple
 
 
 def page_size_from_env(default: int = 16) -> int:
-    """PADDLE_TPU_PAGE_SIZE: tokens per KV page.  16 fills a whole
-    sublane tile in bf16 (and two in f32) — the smallest size the Pallas
-    kernel gate accepts; raise it to trade page-table length for
-    allocation granularity."""
-    try:
-        return int(os.environ.get("PADDLE_TPU_PAGE_SIZE", str(default)))
-    except ValueError:
-        return default
+    """Tokens per KV page — the paged-attention kernel's tile and the
+    allocator's granularity.  16 fills a whole sublane tile in bf16
+    (and two in f32) — the smallest size the Pallas kernel gate
+    accepts; raise it to trade page-table length for allocation
+    granularity.  Resolved through the autotune knob layer: an active
+    trial override, then PADDLE_TPU_PAGE_SIZE (VALIDATED now — garbage
+    used to silently fall back to the default), then the persisted
+    `paddle tune` winner for this device, then `default`."""
+    from ..autotune import knobs
+
+    return knobs.paged_page_size(default)
 
 
 def pages_needed(tokens: int, page_size: int) -> int:

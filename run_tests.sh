@@ -30,6 +30,13 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 env JAX_PLATFORMS=cpu python tools/pred_vs_measured.py --smoke > /dev/null \
     || { echo "telemetry smoke failed (rc=$?)"; exit 1; }
 
+# autotune smoke (docs/autotune.md ISSUE 14): the analyzer-guided
+# tuner's rank -> measure -> persist -> cache-hit loop over a tiny
+# space with the deterministic mock measurer in a throwaway store —
+# also proves memory-infeasible candidates never reach a trial
+env JAX_PLATFORMS=cpu python -m paddle_tpu tune gpt_small --smoke \
+    || { echo "autotune smoke failed (rc=$?)"; exit 1; }
+
 # chaos smoke (docs/distributed.md): one seeded worker-kill against the
 # elastic training service, recovery proved equivalent to the
 # uninterrupted reference by the PR 10 differential oracle — <30s, fails

@@ -251,6 +251,19 @@ CAPTURES = [
       "--trace", os.path.join(OUT, "pred_vs_measured_trace.json"),
       "--metrics", os.path.join(OUT, "pred_vs_measured_metrics.json")],
      {}, 580),
+    # autotune sweep (ISSUE 14 / ROADMAP #3): the analyzer-guided tuner
+    # over gpt-small attention, bn-conv (the v2 >=1.0x-or-delete A/B on
+    # real silicon — the CPU run can only time the interpreter), and
+    # the LSTM step, measuring EVERY feasible candidate so the emitted
+    # rank error judges the static prior against the true measured
+    # winner; the lstm_step_ms_reconciliation row settles the
+    # 6.97-vs-9.89 ms discrepancy under one methodology-labeled run
+    ("autotune_sweep",
+     [sys.executable, "tools/autotune_sweep.py",
+      "--out", os.path.join(OUT, "autotune_sweep_rows.json"),
+      "--metrics", os.path.join(OUT, "autotune_sweep_metrics.json"),
+      "--trace", os.path.join(OUT, "autotune_sweep_trace.json")],
+     {}, 1800),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},

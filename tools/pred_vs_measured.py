@@ -91,9 +91,20 @@ def _build_small_lm():
     return feed, [loss], bs
 
 
+def _build_lstm():
+    """The LSTM step program (ISSUE 14 satellite): the 6.97-vs-9.89 ms
+    family gets a standing predicted-vs-measured row — shares the
+    autotune workload's builder so `paddle tune lstm`, the sweep
+    artifact, and this accounting row all describe the SAME program."""
+    from paddle_tpu.autotune.workloads import _build_lstm as build
+
+    return build()
+
+
 MODELS = (("fit_a_line", _build_fit_a_line),
           ("recognize_digits", _build_recognize_digits),
-          ("small_lm", _build_small_lm))
+          ("small_lm", _build_small_lm),
+          ("lstm", _build_lstm))
 
 
 def run_model(name, builder, steps, chip):

@@ -51,6 +51,17 @@ Three classes of rot this repo has actually accumulated:
      through): a tripwire, not an AST proof.  `tests/` are exempt —
      they corrupt checkpoints on purpose.
 
+  9. raw tuning-knob env reads outside ``paddle_tpu/autotune/`` — the
+     autotuner (ISSUE 14) made PADDLE_TPU_FLASH_BQ/BK,
+     PADDLE_TPU_BNCONV_*, PADDLE_TPU_PAGE_SIZE and friends an explicit
+     OVERRIDE LAYER resolved (and validated) in
+     ``paddle_tpu/autotune/knobs.py``: trial override > env > winner
+     store > default.  A raw ``os.environ`` read of a knob-class name
+     anywhere else re-creates the pre-ISSUE-14 world where the env var
+     is the only mechanism, the store is silently bypassed, and
+     garbage values int()-crash at trace time.  Line-anchored
+     tripwire; ``tests/`` exempt (they monkeypatch knobs on purpose).
+
 Usage: ``python tools/repo_lint.py [root]`` — prints findings, exits 1 if
 any.  `tests/` is exempt from the __init__ rule (pytest rootdir-style
 test trees are intentionally not packages).
@@ -269,6 +280,49 @@ def _check_ckpt_writes(root, dirpath, filenames, findings):
             pass
 
 
+# the tuning-knob env guard: os.environ reads of knob-class names
+# outside the autotune package.  The name list is the knob-class
+# definition — extend it when a new tunable parameter gains an env
+# override (and route the read through autotune/knobs.py).
+_KNOB_ENV_RE = re.compile(
+    r"os\.environ\b[^\n]*PADDLE_TPU_(?:FLASH_|BNCONV_|PAGE_SIZE"
+    r"|AUTOTUNE\b)")
+_KNOB_ENV_DIRS = ("paddle_tpu", "tools")
+_KNOB_ENV_OK_DIR = os.path.join("paddle_tpu", "autotune")
+
+
+def _check_knob_env(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    top = "" if rel_dir == "." else rel_dir.split(os.sep)[0]
+    if top and top not in _KNOB_ENV_DIRS:
+        return
+    if rel_dir == _KNOB_ENV_OK_DIR \
+            or rel_dir.startswith(_KNOB_ENV_OK_DIR + os.sep):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel == os.path.join("tools", "repo_lint.py"):
+            continue
+        if top == "" and fname not in ("bench.py", "__graft_entry__.py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _KNOB_ENV_RE.search(line):
+                        findings.append(
+                            f"raw tuning-knob env read: {rel}:{i} "
+                            f"(resolve through paddle_tpu/autotune/"
+                            f"knobs.py — trial override > validated "
+                            f"env > winner store > default — so the "
+                            f"env var stays an override layer, not "
+                            f"the only mechanism)")
+        except OSError:
+            pass
+
+
 # the PTV rule/doc drift guard: rule registrations in verifier.py vs
 # catalog rows in docs/analysis.md
 _RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
@@ -342,6 +396,7 @@ def lint(root: str):
         _check_partition_spec(root, dirpath, filenames, findings)
         _check_page_table(root, dirpath, filenames, findings)
         _check_perf_counter(root, dirpath, filenames, findings)
+        _check_knob_env(root, dirpath, filenames, findings)
         _check_ckpt_writes(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
