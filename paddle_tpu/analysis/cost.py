@@ -173,16 +173,43 @@ def op_cost(block, op, batch_size: int = 64) -> dict:
 # program roll-up
 
 
+def _calibration_factors(chip: str, calibration: Optional[bool]) -> dict:
+    """The chip's stored correction factors, or {} when the calibrated
+    layer is off (arg False, or arg None + $PADDLE_TPU_CALIBRATION=0)
+    or nothing has been learned yet."""
+    if calibration is False:
+        return {}
+    from ..observability import calibration as _calib
+
+    if calibration is None and not _calib.calibration_enabled():
+        return {}
+    return _calib.default_store().factors(chip)
+
+
 def program_cost(program, batch_size: int = 64, block_id: int = 0,
-                 chip: Optional[str] = None) -> dict:
+                 chip: Optional[str] = None,
+                 calibration: Optional[bool] = None) -> dict:
     """Roofline report for one block: totals, a per-op-type table (by
     FLOPs, descending), arithmetic intensity, and the predicted step
-    time/MFU ceiling for `chip` (see module docstring for the model)."""
+    time/MFU ceiling for `chip` (see module docstring for the model).
+
+    `calibration`: None defers to $PADDLE_TPU_CALIBRATION (default on);
+    when factors exist for this chip the report ADDS
+    ``calibrated_step_time_s`` (per-op roofline times priced through
+    the measured per-(op type, dtype) affine corrections — ``factor *
+    t_op + overhead_s`` — from observability/calibration.py, summed)
+    beside the raw model — the
+    raw keys never change, so uncalibrated consumers are unaffected."""
     block = program.blocks[block_id]
     spec = chip_spec(chip)
+    peak = spec["flops_bf16"]
+    bw = spec["hbm_gbps"] * 1e9
+    factors = _calibration_factors(spec["chip"], calibration)
     by_type: Dict[str, dict] = {}
     flops_by_dtype: Dict[str, int] = {}
     tot_flops = tot_bytes = tot_coll = 0
+    per_op_time = cal_time = 0.0
+    applied = 0
     unmodeled = 0
     for op in block.ops:
         c = op_cost(block, op, batch_size)
@@ -198,11 +225,29 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
         tot_coll += c["collective_bytes"]
         dt = c["dtype"] or "float32"
         flops_by_dtype[dt] = flops_by_dtype.get(dt, 0) + c["flops"]
+        # per-op roofline time (max of the op's own compute/memory
+        # legs): Σ over ops is the no-overlap-across-ops variant the
+        # calibration factors scale; the raw headline below keeps the
+        # perfect-overlap max-of-sums model
+        rate = peak * _DTYPE_RATE.get(dt, 0.5)
+        t_op = max(c["flops"] / rate if rate else 0.0,
+                   c["bytes"] / bw if bw else 0.0)
+        per_op_time += t_op
+        if factors:
+            from ..observability import calibration as _calib
 
-    peak = spec["flops_bf16"]
+            ent = factors.get(_calib.factor_key(op.type, dt))
+            if ent:
+                # affine: the fitted overhead_s charges the per-op
+                # dispatch floor a ratio cannot see (calibration.py)
+                cal_time += (float(ent["factor"]) * t_op
+                             + float(ent.get("overhead_s") or 0.0))
+                applied += 1
+            else:
+                cal_time += t_op
+
     t_compute = sum(f / (peak * _DTYPE_RATE.get(dt, 0.5))
                     for dt, f in flops_by_dtype.items() if f)
-    bw = spec["hbm_gbps"] * 1e9
     t_memory = tot_bytes / bw if bw else 0.0
     step = max(t_compute, t_memory)
     report = {
@@ -224,9 +269,15 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
         # compute-bound): measured_mfu / this ratio = tuner headroom
         "mfu_ceiling": (t_compute / step) if step else 0.0,
         "unmodeled_ops": int(unmodeled),
+        "per_op_time_s": per_op_time,
         "by_type": dict(sorted(by_type.items(),
                                key=lambda kv: -kv[1]["flops"])),
     }
+    if factors and applied:
+        report["calibrated_step_time_s"] = cal_time
+        report["calibration"] = {"chip": spec["chip"],
+                                 "factors_applied": int(applied),
+                                 "factors_known": len(factors)}
     return report
 
 

@@ -42,21 +42,30 @@ def _resolve_chip(chip: Optional[str]) -> str:
 
 class PricedCandidate:
     __slots__ = ("candidate", "predicted_step_s", "predicted_peak_bytes",
-                 "feasible", "reject_reason", "bound")
+                 "feasible", "reject_reason", "bound",
+                 "raw_step_s", "calibrated")
 
     def __init__(self, candidate, predicted_step_s, predicted_peak_bytes,
-                 feasible=True, reject_reason="", bound=""):
+                 feasible=True, reject_reason="", bound="",
+                 raw_step_s=None, calibrated=False):
         self.candidate = candidate
         self.predicted_step_s = predicted_step_s
         self.predicted_peak_bytes = predicted_peak_bytes
         self.feasible = feasible
         self.reject_reason = reject_reason
         self.bound = bound
+        # the raw (uncalibrated) roofline price is ALWAYS carried
+        # alongside (ISSUE 16: calibration never hides the raw model)
+        self.raw_step_s = (predicted_step_s if raw_step_s is None
+                           else raw_step_s)
+        self.calibrated = calibrated
 
     def row(self) -> dict:
         return {"params": dict(self.candidate.params),
                 "digest": self.candidate.digest,
                 "predicted_step_s": self.predicted_step_s,
+                "predicted_raw_step_s": self.raw_step_s,
+                "calibrated": self.calibrated,
                 "predicted_peak_bytes": self.predicted_peak_bytes,
                 "feasible": self.feasible,
                 "reject_reason": self.reject_reason,
@@ -69,9 +78,10 @@ def price(workload, candidate, chip: Optional[str] = None,
     """One candidate's static price + feasibility verdict.
 
     `_desc_cache` (rank() supplies one) memoizes the program build +
-    cost/peak analysis per desc-affecting key — only the `remat` axis
-    changes the desc, so candidates differing in kernel knobs/flags
-    share one analysis instead of rebuilding identical programs."""
+    cost/peak analysis per desc-affecting key (the workload's
+    ``desc_key`` hook; by default only the `remat` axis changes the
+    desc), so candidates differing in kernel knobs/flags share one
+    analysis instead of rebuilding identical programs."""
     from ..analysis import memory as _mem
 
     spec = _cost.chip_spec(_resolve_chip(chip))
@@ -85,7 +95,8 @@ def price(workload, candidate, chip: Optional[str] = None,
     if not ok:
         return PricedCandidate(candidate, float("inf"), 0, False, why)
 
-    desc_key = bool(candidate.get("remat"))
+    desc_key = getattr(workload, "desc_key",
+                       lambda c: bool(c.get("remat")))(candidate)
     cached = (_desc_cache or {}).get(desc_key)
     if cached is not None:
         report, peak = cached  # skips the program rebuild entirely
@@ -124,10 +135,19 @@ def price(workload, candidate, chip: Optional[str] = None,
                           lambda c, s: 0.0)(candidate, spec))
     t_memory = (report["hbm_bytes"] + extra) / (spec["hbm_gbps"] * 1e9)
     t_compute = report["compute_time_s"]
-    step = max(t_compute, t_memory)
+    raw_step = max(t_compute, t_memory)
+    # ISSUE 16: when measured calibration factors exist for this chip
+    # ($PADDLE_TPU_CALIBRATION gate, observability/calibration.py) the
+    # candidate is RANKED by the calibrated per-op time; the raw
+    # roofline price rides along in every row.  The kernel-analytic
+    # path above stays raw — factors are keyed by desc op type.
+    cal = report.get("calibrated_step_time_s")
+    step = (float(cal) + extra / (spec["hbm_gbps"] * 1e9)
+            if cal is not None else raw_step)
     return PricedCandidate(
         candidate, step, int(peak["total_peak_bytes"]),
-        bound="compute" if t_compute >= t_memory else "memory")
+        bound="compute" if t_compute >= t_memory else "memory",
+        raw_step_s=raw_step, calibrated=cal is not None)
 
 
 def rank(workload, candidates, chip: Optional[str] = None,

@@ -173,6 +173,17 @@ def paged_space(max_ctx: int = 1024) -> SearchSpace:
     ])
 
 
+def mlp_depth_space(depths: Sequence[int] = (16, 4, 1)) -> SearchSpace:
+    """Depth-vs-width axis at ~constant hidden FLOPs (depth * width^2
+    fixed): the op-COUNT workload.  The deepest stack is the default
+    (first value) on purpose — the raw roofline prices it cheapest
+    (slightly fewer projection FLOPs/bytes), while the measured winner
+    on a dispatch-overhead-dominated host is the shallow build, so this
+    axis is rankable only by a cost layer that charges per-op overhead
+    (the calibration store's affine fit)."""
+    return SearchSpace([Choice("mlp.depth", tuple(depths))])
+
+
 def remat_space(xla_flags: Sequence[str] = ("",)) -> SearchSpace:
     """Generic program space (saved models): remat on/off x flags."""
     return SearchSpace([

@@ -137,6 +137,12 @@ class ProgramWorkload:
         return self._kernel_sites
 
     # -- prior hooks -----------------------------------------------------
+    def desc_key(self, candidate):
+        """The candidate axes that change the built ProgramDesc — the
+        prior's per-desc analysis cache key.  Base workloads: remat
+        only; override when another axis rebuilds the program."""
+        return bool(candidate.get("remat"))
+
     def program_for(self, candidate) -> Tuple[object, int]:
         b = self.build(candidate)
         return b.main, b.batch_size
@@ -268,6 +274,57 @@ def _build_lstm():
 
 def _lstm_space():
     return _space.remat_space(xla_flags=_flag_menu())
+
+
+# depth -> width such that the fc-chain weight count 64*w + (d-1)*w^2
+# stays ~65536 across candidates: ~equal FLOPs/bytes, 1x-vs-16x op count
+_MLP_WIDTHS = {16: 64, 4: 136, 1: 1024}
+
+
+def _build_mlp(depth: int):
+    """Inference MLP chain: in(64) -> depth x fc(width) -> fc(8), with
+    the total matmul work held ~constant (see _MLP_WIDTHS).  The deep
+    build wins the RAW roofline (the shallow build's wide output
+    projection costs it ~12% extra FLOPs and bytes) yet measures slower
+    wherever per-op dispatch overhead is real — the failure class the
+    calibration store's overhead term exists to price
+    (observability/calibration.py)."""
+    import paddle_tpu as fluid
+
+    width = _MLP_WIDTHS[int(depth)]
+    bs, in_dim = 8, 64
+    x = fluid.layers.data(name="x", shape=[in_dim], dtype="float32")
+    h = x
+    for _ in range(int(depth)):
+        h = fluid.layers.fc(h, size=width, act="relu")
+    out = fluid.layers.fc(h, size=8, act=None)
+    rng = np.random.RandomState(13)
+    feed = {"x": rng.randn(bs, in_dim).astype(np.float32)}
+    return feed, [out], bs
+
+
+class MlpDepthWorkload(ProgramWorkload):
+    """The op-count A/B (ISSUE 16): same task, ~same FLOPs, 1x/4x/16x
+    the op count.  Exists to exercise — and to be un-rankable without —
+    the calibrated prior's per-op overhead term; the raw rank error it
+    records is a FEATURE of the artifact, not a model bug to paper
+    over."""
+
+    def __init__(self):
+        super().__init__("mlp_depth", None, _space.mlp_depth_space)
+
+    def desc_key(self, candidate):
+        return int(candidate.get("mlp.depth", 16))
+
+    def build(self, candidate) -> Built:
+        from ..framework import unique_name
+        from ..framework.core import Program, program_guard
+
+        depth = int(candidate.get("mlp.depth", 16)) if candidate else 16
+        main, startup = Program(), Program()
+        with unique_name.guard(), program_guard(main, startup):
+            feed, fetch, bs = _build_mlp(depth)
+        return Built(main, startup, feed, fetch, bs)
 
 
 # ---------------------------------------------------------------------------
@@ -558,6 +615,7 @@ WORKLOADS: Dict[str, Callable[[], object]] = {
     "bn_conv": BnConvWorkload,
     "paged_decode": PagedDecodeWorkload,
     "lstm": lambda: ProgramWorkload("lstm", _build_lstm, _lstm_space),
+    "mlp_depth": MlpDepthWorkload,
 }
 
 

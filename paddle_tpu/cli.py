@@ -530,6 +530,142 @@ def cmd_trace(args) -> int:
     return 1 if problems else 0
 
 
+def cmd_attribute(args) -> int:
+    """`paddle attribute MODEL` — the ISSUE 16 per-op device-time
+    attribution table.  MODEL is a standing calibration program
+    (fit_a_line|recognize_digits|small_lm|lstm, models/standing.py) or
+    a saved-model dir/file.
+
+    Runs the deterministic CPU segment oracle
+    (observability/attribution.py), joins measured per-op time against
+    the static cost model, publishes the op_pred_vs_measured gauges,
+    and emits ONE bench-schema artifact line.  --profile additionally
+    captures a jax.profiler trace of jitted steps with the op identity
+    scopes threaded (the on-chip `op_attribution` evidence capture);
+    --update-calibration feeds the table into the calibration store the
+    autotune prior consumes."""
+    import json as _json
+
+    from . import observability as obs
+    from .analysis import cost as acost
+
+    if args.calibration_root:
+        os.environ["PADDLE_TPU_CALIBRATION_CACHE"] = os.path.abspath(
+            args.calibration_root)
+    chip = args.chip or acost.detect_chip()
+
+    import paddle_tpu as fluid
+    from .models.standing import get_builder
+
+    builder = get_builder(args.model)
+    if builder is not None:
+        label = args.model
+        fluid.reset()
+        feed, fetch, bs = builder()
+        program = fluid.default_main_program()
+        exe = fluid.Executor(fluid.default_place())
+        exe.run(fluid.default_startup_program())
+        scope = None  # the startup run populated the global scope
+    else:
+        from .analysis import equivalence as eqv
+        from .analysis.dataflow import state_classes
+        from .framework.executor import Executor
+        from .framework.place import CPUPlace
+        from .framework.scope import Scope
+
+        program, feed_names, fetch = _load_program_any(args.model)
+        block = program.global_block()
+        if fetch is None:
+            fetch = eqv.sink_outputs(block)
+        if feed_names is None:
+            feed_names = [v.name for v in block.vars.values()
+                          if v.is_data]
+        label = (os.path.basename(os.path.normpath(args.model))
+                 or "model").replace("-", "_").replace(".", "_")
+        bs = args.batch_size
+        feed = eqv.build_feeds(program, feed_names, batch_size=bs)
+        scope = _load_scope_for(args.model) or Scope()
+        # saved dirs carry persistables; anything else the block reads
+        # is seeded deterministically by name (the oracle idiom)
+        ext, rw, _ = state_classes(block, list(feed))
+        for name in list(ext) + list(rw):
+            if scope.find(name) is not None:
+                continue
+            dv = block._find_var_recursive(name)
+            if dv is not None and dv.shape is not None:
+                scope.set(name, eqv._seed_array(
+                    name, eqv._bind(dv.shape, 1), dv.dtype or "float32",
+                    0))
+        exe = Executor(CPUPlace())
+
+    table = obs.attribution.attribute_cpu(
+        program, feed, scope=scope, batch_size=bs,
+        repeats=args.repeats, chip=chip)
+    obs.attribution.publish(table, label)
+    row = obs.attribution.artifact_row(table, label)
+
+    if args.profile:
+        # jitted steps under jax.profiler with the identity scopes
+        # forced on; a FRESH executor so the step compiles scoped
+        # instead of reusing an unscoped cached executable
+        pexe = fluid.Executor(fluid.default_place()) \
+            if builder is not None else type(exe)(exe.place)
+
+        def step(i):
+            pexe.run(program, feed=dict(feed), fetch_list=list(fetch),
+                     scope=scope, rng_step=i)
+
+        cap = obs.attribution.capture_profile(step, args.profile,
+                                              steps=args.steps)
+        row["profile_trace"] = cap["trace_file"] or cap["trace_dir"]
+        if cap["by_scope"]:
+            ptab = obs.attribution.table_from_scopes(
+                program.global_block(), cap["by_scope"],
+                batch_size=bs, chip=chip)
+            row["profile_table"] = obs.attribution.artifact_row(
+                ptab, label)["by_type"]
+
+    if args.update_calibration:
+        entry = obs.calibration.default_store().record_attribution(table)
+        row["calibration_updated"] = bool(entry)
+
+    if args.smoke:
+        # the run_tests.sh attribution gate (acceptance: >=80% of
+        # measured step time attributed to named desc ops)
+        assert table["coverage"] >= 0.8, \
+            f"attribution coverage {table['coverage']:.3f} < 0.8"
+        assert table["n_ops"] > 0 and table["by_type"], table["n_ops"]
+        assert all(r["uid"] >= 0 for r in table["rows"]), \
+            "desc op without a __uid__ in the attribution table"
+        snapshot = obs.REGISTRY.snapshot()
+        sp = obs.validate_snapshot(snapshot)
+        assert not sp, f"snapshot schema: {sp}"
+        for fam in ("op_pred_vs_measured", "op_measured_time_share",
+                    "op_attribution_coverage"):
+            assert fam in snapshot["families"], f"missing family {fam}"
+        print(f"# attribution smoke OK ({label}: {table['n_ops']} ops, "
+              f"coverage {table['coverage']:.3f}, top "
+              f"{table['top_op']})", file=sys.stderr)
+
+    line = _json.dumps(row)
+    if not args.json:
+        print(f"attribution {label} ({table['mode']}, chip "
+              f"{table['chip']}): {table['n_ops']} ops, "
+              f"{table['total_s'] * 1e3:.3f} ms/walk, coverage "
+              f"{table['coverage']:.3f}", file=sys.stderr)
+        for t, e in list(table["by_type"].items())[:args.top]:
+            print(f"  {t:<28} x{e['count']:<4} "
+                  f"{e['measured_share'] * 100:6.2f}% measured  "
+                  f"{e['pred_share'] * 100:6.2f}% predicted  "
+                  f"pred/meas {e['pred_vs_measured']:.2e}",
+                  file=sys.stderr)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
 def cmd_tune(args) -> int:
     """`paddle tune WORKLOAD` — the ISSUE 14 search loop.  WORKLOAD is
     a registered name (gpt_small, bn_conv, paged_decode, lstm) or a
@@ -827,6 +963,40 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None,
                    help="trace path (default MODEL.trace.json)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("attribute")
+    p.add_argument("model",
+                   help="standing program (fit_a_line|recognize_digits|"
+                        "small_lm|lstm) or a saved-model dir/file")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="oracle walks per op (median is reported)")
+    p.add_argument("--steps", type=int, default=3,
+                   help="jitted steps under --profile")
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="binds -1 feed dims of saved models")
+    p.add_argument("--chip", default=None,
+                   help="chip spec for the predicted column (default: "
+                        "detected backend)")
+    p.add_argument("--top", type=int, default=8,
+                   help="op types shown in the human table")
+    p.add_argument("--profile", default=None,
+                   help="also capture a jax.profiler trace (Perfetto) "
+                        "of jitted steps into this dir — the on-chip "
+                        "op_attribution evidence path")
+    p.add_argument("--update-calibration", action="store_true",
+                   help="feed the table into the calibration store "
+                        "(observability/calibration.py)")
+    p.add_argument("--calibration-root", default=None,
+                   help="calibration store dir (default "
+                        "$PADDLE_TPU_CALIBRATION_CACHE or "
+                        "~/.cache/paddle_tpu/calibration)")
+    p.add_argument("--json", action="store_true",
+                   help="suppress the human table (artifact line only)")
+    p.add_argument("--out", default=None,
+                   help="also write the artifact line to FILE")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: coverage/schema asserts")
+    p.set_defaults(fn=cmd_attribute)
 
     p = sub.add_parser("tune")
     p.add_argument("workload",

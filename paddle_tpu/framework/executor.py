@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..observability import accounting as _acct
+from ..observability import attribution as _attr
 from ..observability.metrics import REGISTRY as _MET, monotime as _monotime
 from ..observability.tracing import TRACER as _TRC
 from ..ops.registry import EmitContext, get_op_info
@@ -669,45 +670,58 @@ class Executor:
         self._cache.clear()
 
 
+def _lower_op(op, env, ctx):
+    """Lower ONE op: build its slot inputs from the SSA env, emit, write the
+    outputs back.  Shared by the whole-block trace below and the attribution
+    oracle's segment-timed eager walk (observability/attribution.py), so both
+    thread values identically."""
+    try:
+        info = get_op_info(op.type)
+        ins = {
+            slot: [env[n] if n else None for n in names]
+            for slot, names in op.inputs.items()
+        }
+        attrs = op.attrs
+        if op.type == "generic_grad":
+            attrs = dict(op.attrs)
+            attrs["__wanted__"] = {
+                (slot[: -len("@GRAD")], i)
+                for slot, names in op.outputs.items()
+                for i, n in enumerate(names)
+                if n
+            }
+        outs = info.emit(ctx, ins, attrs)
+    except OpLoweringError:
+        raise
+    except Exception as e:
+        # PADDLE_ENFORCE parity (enforce.h:64): a failing op names itself
+        # and its variables instead of surfacing a bare JAX traceback
+        in_names = {s: list(ns) for s, ns in op.inputs.items() if ns}
+        out_names = {s: list(ns) for s, ns in op.outputs.items() if ns}
+        raise OpLoweringError(
+            f"error lowering op {op.type!r} "
+            f"(inputs={in_names}, outputs={out_names}): "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, []) if outs else []
+        for i, n in enumerate(names):
+            if not n:
+                continue
+            if i < len(vals) and vals[i] is not None:
+                env[n] = vals[i]
+    return outs
+
+
 def _lower_ops(ops, env, ctx):
     """Trace every op's emitter into the surrounding JAX trace, threading the
-    SSA environment (name → traced array)."""
+    SSA environment (name → traced array).  With op attribution enabled each
+    op is wrapped in its identity named-scope so every HLO instruction maps
+    back to its desc op; disabled, the scope is a shared no-op (one attribute
+    check per op per TRACE, never per step)."""
     for op in ops:
         if op.type in _NOOP_TYPES:
             continue
-        try:
-            info = get_op_info(op.type)
-            ins = {
-                slot: [env[n] if n else None for n in names]
-                for slot, names in op.inputs.items()
-            }
-            attrs = op.attrs
-            if op.type == "generic_grad":
-                attrs = dict(op.attrs)
-                attrs["__wanted__"] = {
-                    (slot[: -len("@GRAD")], i)
-                    for slot, names in op.outputs.items()
-                    for i, n in enumerate(names)
-                    if n
-                }
-            outs = info.emit(ctx, ins, attrs)
-        except OpLoweringError:
-            raise
-        except Exception as e:
-            # PADDLE_ENFORCE parity (enforce.h:64): a failing op names itself
-            # and its variables instead of surfacing a bare JAX traceback
-            in_names = {s: list(ns) for s, ns in op.inputs.items() if ns}
-            out_names = {s: list(ns) for s, ns in op.outputs.items() if ns}
-            raise OpLoweringError(
-                f"error lowering op {op.type!r} "
-                f"(inputs={in_names}, outputs={out_names}): "
-                f"{type(e).__name__}: {e}"
-            ) from e
-        for slot, names in op.outputs.items():
-            vals = outs.get(slot, []) if outs else []
-            for i, n in enumerate(names):
-                if not n:
-                    continue
-                if i < len(vals) and vals[i] is not None:
-                    env[n] = vals[i]
+        with _attr.op_scope(op):
+            _lower_op(op, env, ctx)
     return env

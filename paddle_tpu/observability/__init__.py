@@ -10,7 +10,13 @@ Three layers, one namespace:
     bounded ring, Chrome/Perfetto trace-event export;
   * :mod:`.accounting` — predicted-vs-measured: static cost/memory
     predictions attached per program, measured step times and XLA peaks
-    recorded against them, error ratios materialized as metrics.
+    recorded against them, error ratios materialized as metrics;
+  * :mod:`.attribution` — per-op device-time attribution (ISSUE 16):
+    named-scope identity threading, the profile capture + CPU segment
+    oracle, and the per-op predicted-vs-measured table;
+  * :mod:`.calibration` — the sealed per-(op type, chip, dtype)
+    correction-factor store the attribution tables feed and the cost
+    model/autotune prior consume.
 
 Usage:
 
@@ -27,6 +33,8 @@ executor/serving/service hot paths stays compiled in at all times.
 """
 
 from . import accounting  # noqa: F401
+from . import attribution  # noqa: F401
+from . import calibration  # noqa: F401
 from . import metrics  # noqa: F401
 from . import tracing  # noqa: F401
 from .httpd import TelemetryServer, serve_http  # noqa: F401
@@ -103,3 +111,4 @@ def reset():
     REGISTRY.reset()
     TRACER.reset()
     accounting.reset()
+    attribution.reset()

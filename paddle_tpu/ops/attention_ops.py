@@ -218,7 +218,8 @@ def paged_prefill(ctx, ins, attrs):
     import jax
     import jax.numpy as jnp
 
-    from .transformer_ops import _flash_ok, _lm_fns, _prompt_2d
+    from .transformer_ops import (_flash_ok, _lm_fns, _prompt_2d,
+                                  stable_argmax)
 
     nh = int(attrs["n_heads"])
     ps = int(attrs["page_size"])
@@ -260,7 +261,7 @@ def paged_prefill(ctx, ins, attrs):
     # gather first): [N,1,D]
     last = jnp.take_along_axis(
         x, (plen - 1).astype(jnp.int32)[:, None, None], axis=1)
-    first = jnp.argmax(fns.head_logits(last), axis=-1).astype(jnp.int64)
+    first = stable_argmax(fns.head_logits(last), jnp.int64)
 
     # scatter every prompt position's K/V into its page: position p ->
     # physical page pt[n, p // ps], in-page slot p % ps
@@ -294,7 +295,7 @@ def paged_decode_step(ctx, ins, attrs):
     import jax.numpy as jnp
 
     from .pallas_kernels import paged_attention as pa
-    from .transformer_ops import _lm_fns
+    from .transformer_ops import _lm_fns, stable_argmax
 
     nh = int(attrs["n_heads"])
     ps = int(attrs["page_size"])
@@ -338,7 +339,7 @@ def paged_decode_step(ctx, ins, attrs):
     x = xt
     for i in range(fns.L):
         x = fns.block(i, x, attend)
-    nxt = jnp.argmax(fns.head_logits(x), axis=-1).astype(jnp.int32)
+    nxt = stable_argmax(fns.head_logits(x), jnp.int32)
     nxt = jnp.where(act, nxt, 0).astype(jnp.int64)
     return {"NextToken": [nxt], "KPoolOut": [hold["k"]],
             "VPoolOut": [hold["v"]]}
@@ -370,7 +371,7 @@ def paged_prefill_chunk(ctx, ins, attrs):
     import jax
     import jax.numpy as jnp
 
-    from .transformer_ops import _lm_fns, _prompt_2d
+    from .transformer_ops import _lm_fns, _prompt_2d, stable_argmax
 
     nh = int(attrs["n_heads"])
     ps = int(attrs["page_size"])
@@ -431,7 +432,7 @@ def paged_prefill_chunk(ctx, ins, attrs):
     last = jnp.take_along_axis(
         x, jnp.maximum(clen - 1, 0).astype(jnp.int32)[:, None, None],
         axis=1)  # [K,1,D]
-    nxt = jnp.argmax(fns.head_logits(last), axis=-1).astype(jnp.int32)
+    nxt = stable_argmax(fns.head_logits(last), jnp.int32)
     nxt = jnp.where(clen > 0, nxt, 0).astype(jnp.int64)
     return {"NextToken": [nxt], "KPoolOut": [hold["k"]],
             "VPoolOut": [hold["v"]]}

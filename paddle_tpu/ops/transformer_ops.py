@@ -164,6 +164,22 @@ def _prompt_2d(ins):
     return tokens.astype(jnp.int32)
 
 
+def stable_argmax(logits, dtype):
+    """Greedy pick, STABLE under tie-adjacent float wobble: plain
+    jnp.argmax on raw logits can flip between two near-equal maxima
+    depending on fusion/reduction order, which differs between the
+    paged engine's batch layout and the fused generate's — splitting
+    the serving A/B token-identity check on ties.  Compare in f32
+    against the row max with a small slack and take the LOWEST index
+    at/above it (bool argmax returns the first True), so every decode
+    path resolves a tie to the same token (docs/serving.md)."""
+    import jax.numpy as jnp
+
+    z = logits.astype(jnp.float32)
+    m = jnp.max(z, axis=-1, keepdims=True)
+    return jnp.argmax(z >= m - 1e-4, axis=-1).astype(dtype)
+
+
 @register_op("gpt_decode", grad=None)
 def gpt_decode(ctx, ins, attrs):
     """Greedy / sampled KV-cached generation.
@@ -194,7 +210,7 @@ def gpt_decode(ctx, ins, attrs):
         """Next-token rule: greedy, or temperature/top-k sampling with a
         per-step key (deterministic replay: base key folded with t)."""
         if temp <= 0.0:
-            return jnp.argmax(logits_f32, axis=-1).astype(jnp.int32)
+            return stable_argmax(logits_f32, jnp.int32)
         z = logits_f32 / temp
         if top_k > 0:
             k_eff = min(top_k, z.shape[-1])  # top_k > V would fail in

@@ -37,6 +37,29 @@ env JAX_PLATFORMS=cpu python tools/pred_vs_measured.py --smoke > /dev/null \
 env JAX_PLATFORMS=cpu python -m paddle_tpu tune gpt_small --smoke \
     || { echo "autotune smoke failed (rc=$?)"; exit 1; }
 
+# attribution smoke + regression sentinel (docs/observability.md ISSUE
+# 16): `paddle attribute` runs the deterministic CPU segment oracle
+# over fit-a-line — asserts >=80% of measured step time lands on named
+# desc ops and the artifact/snapshot schemas hold — then the sentinel
+# (a) proves its own verdict logic on a synthetic pair (identical=PASS,
+# injected slowdown=REGRESSED naming the guilty op) and (b) diffs the
+# fresh artifact against the committed golden baseline.  The golden
+# compare scores the COVERAGE metric (machine-independent, ~1.0
+# everywhere); raw per-op times never gate CI.  Calibration-store
+# writes are opt-in (--update-calibration), so this gate cannot
+# contaminate later `paddle tune` pricing.
+attr_tmp=$(mktemp -d)
+env JAX_PLATFORMS=cpu python -m paddle_tpu attribute fit_a_line --smoke \
+    --json --out "$attr_tmp/attribution.json" > /dev/null \
+    || { echo "attribution smoke failed (rc=$?)"; rm -rf "$attr_tmp"; exit 1; }
+python tools/sentinel.py --self-test \
+    || { echo "sentinel self-test failed (rc=$?)"; rm -rf "$attr_tmp"; exit 1; }
+python tools/sentinel.py --baseline tools/sentinel_golden.json \
+    --candidate "$attr_tmp/attribution.json" --threshold 0.5 \
+    || { echo "sentinel flagged a regression vs the golden baseline (rc=$?)"; \
+         rm -rf "$attr_tmp"; exit 1; }
+rm -rf "$attr_tmp"
+
 # chaos smoke (docs/distributed.md): one seeded worker-kill against the
 # elastic training service, recovery proved equivalent to the
 # uninterrupted reference by the PR 10 differential oracle — <30s, fails

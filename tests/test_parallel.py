@@ -750,7 +750,7 @@ def test_fsdp_leaves_frozen_params_replicated():
     assert tuple(w2.sharding.spec)[:1] == ("dp",), w2.sharding.spec
 
 
-@isolated_native("parallel_tail_4")
+@isolated_native("parallel_tail_4", fixed_outcome=True)
 def test_sharded_checkpoint_roundtrip_fsdp(tmp_path):
     """Checkpoint/resume with ZeRO-3 param sharding: save gathers the
     1/dp-sharded params, load re-shards them, trajectory continues

@@ -11,6 +11,17 @@ import paddle_tpu as fluid
 from paddle_tpu.framework import proto_io
 from paddle_tpu.native import program_desc as npd
 
+# 12 protoc-rooted failures converted to deterministic skips (ISSUE 16
+# satellite): these tests need the generated framework_pb2 bindings,
+# which this image can neither regenerate (no protoc) nor ship cached.
+# TRACKING: remove `needs_protoc` once the image bakes in protoc or the
+# repo commits the generated bindings (same containment as
+# test_utils_tools.py's v1-golden pair, ISSUE 13).
+needs_protoc = pytest.mark.skipif(
+    not proto_io.proto_bindings_available(),
+    reason="protoc unavailable and no cached framework_pb2 "
+           "(deterministic containment, ISSUE 16)")
+
 
 def _build_linear():
     fluid.reset()
@@ -21,6 +32,7 @@ def _build_linear():
     return x, y, pred, cost
 
 
+@needs_protoc
 def test_roundtrip_structural_equality():
     _, _, pred, cost = _build_linear()
     prog = fluid.default_main_program()
@@ -36,6 +48,7 @@ def test_roundtrip_structural_equality():
                 == {n: v.to_dict() for n, v in b2.vars.items()})
 
 
+@needs_protoc
 def test_roundtrip_with_control_flow_blocks():
     fluid.reset()
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")
@@ -60,6 +73,7 @@ def test_roundtrip_with_control_flow_blocks():
     assert subs1 == subs2 and subs1
 
 
+@needs_protoc
 def test_roundtrip_executes_identically():
     x, y, pred, cost = _build_linear()
     prog = fluid.default_main_program()
@@ -73,6 +87,7 @@ def test_roundtrip_executes_identically():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
 
 
+@needs_protoc
 def test_text_dump():
     _build_linear()
     txt = proto_io.program_to_text(fluid.default_main_program())
@@ -137,6 +152,7 @@ class TestNativeDesc:
         assert st["blocks"] == 1 and st["ops"] == 5 and st["params"] == 2
 
 
+@needs_protoc
 def test_inference_model_proto_file(tmp_path):
     x, y, pred, cost = _build_linear()
     exe = fluid.Executor(fluid.default_place())
@@ -152,6 +168,7 @@ def test_inference_model_proto_file(tmp_path):
     assert np.asarray(out).shape == (3, 1)
 
 
+@needs_protoc
 def test_cond_branch_blocks_survive_roundtrip_and_prune():
     """cond's true_block/false_block are BLOCK attrs: prune must keep both
     branch sub-blocks and remap their indices."""
@@ -216,6 +233,7 @@ def test_feed_only_backward_for_host_embedding():
     assert np.asarray(g).shape == (2, 8)
 
 
+@needs_protoc
 def test_accumulator_tag_survives_proto_roundtrip():
     """accumulator_for (set by Optimizer._add_accumulator) must round-trip
     through the wire format so ZeRO/placement works on restored programs."""

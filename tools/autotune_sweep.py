@@ -38,7 +38,50 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DEFAULT_WORKLOADS = "gpt_small,bn_conv,lstm"
+DEFAULT_WORKLOADS = "gpt_small,bn_conv,lstm,mlp_depth"
+
+
+def populate_calibration(models=("fit_a_line", "small_lm", "lstm")):
+    """--calibrate: learn measured per-op factors for THIS host by
+    running the attribution oracle over the standing programs
+    (paddle_tpu/models/standing.py) into the calibration store the
+    prior will consume (ISSUE 16)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.standing import get_builder
+    from paddle_tpu.observability import attribution, calibration
+
+    for name in models:
+        fluid.reset()
+        feed, _fetch, bs = get_builder(name)()
+        program = fluid.default_main_program()
+        exe = fluid.Executor(fluid.default_place())
+        exe.run(fluid.default_startup_program())
+        table = attribution.attribute_cpu(program, feed, batch_size=bs,
+                                          repeats=2)
+        calibration.default_store().record_attribution(table)
+        print(f"# calibrated from {name}: {table['n_ops']} ops, "
+              f"coverage {table['coverage']:.3f} "
+              f"(chip {table['chip']})", file=sys.stderr)
+    fluid.reset()
+
+
+def _calibrated_rank(wl, rep):
+    """Re-rank the SAME candidate set with calibration consumption ON —
+    no re-measurement, just a second prior pass — and return where the
+    measured winner sits in the calibrated predicted order.  None when
+    calibration is disabled, the chip has no factors, or the workload
+    never reaches the program-cost path (analytic kernels stay raw)."""
+    from paddle_tpu.autotune import prior
+    from paddle_tpu.observability import calibration as calib
+
+    if not calib.calibration_enabled():
+        return None
+    feasible, _ = prior.rank(wl, wl.space().candidates())
+    if not feasible or not any(p.calibrated for p in feasible):
+        return None
+    order = [p.candidate.digest for p in feasible]
+    win = rep["winner_row"]["digest"]
+    return order.index(win) + 1 if win in order else None
 
 
 def sweep_workload(name, args, measurer):
@@ -47,8 +90,19 @@ def sweep_workload(name, args, measurer):
     from paddle_tpu.autotune import workloads as at_workloads
 
     wl = at_workloads.get_workload(name)
-    rep = autotune.tune(wl, measurer=measurer, top_k=args.top_k,
-                        force=True, measure_all=True)
+    # the tune() pass always ranks RAW (calibration consumption off for
+    # its duration) so rank_error_<wl> stays comparable with the
+    # recorded baseline; the calibrated re-rank below is a separate row
+    prev_gate = os.environ.get("PADDLE_TPU_CALIBRATION")
+    os.environ["PADDLE_TPU_CALIBRATION"] = "0"
+    try:
+        rep = autotune.tune(wl, measurer=measurer, top_k=args.top_k,
+                            force=True, measure_all=True)
+    finally:
+        if prev_gate is None:
+            os.environ.pop("PADDLE_TPU_CALIBRATION", None)
+        else:
+            os.environ["PADDLE_TPU_CALIBRATION"] = prev_gate
     cands = [{
         "digest": t["digest"], "params": t["params"],
         "predicted_s": round(t["predicted_step_s"], 9),
@@ -62,6 +116,15 @@ def sweep_workload(name, args, measurer):
         n_candidates=rep["space_size"], n_measured=len(rep["trials"]),
         n_rejected=rep["n_rejected"],
         winner=rep["winner"], candidates=cands)]
+    cal_rank = _calibrated_rank(wl, rep)
+    if cal_rank is not None:
+        rows.append(obs.artifact_metric(
+            f"autotune_rank_error_calibrated_{name}", cal_rank,
+            "predicted rank of measured winner under measured "
+            "calibration factors (raw rank rides alongside)",
+            raw_rank=rep["rank_of_winner"],
+            improved=cal_rank < rep["rank_of_winner"],
+            in_top_k=cal_rank <= args.top_k, top_k=args.top_k))
     base, win = rep.get("default_row"), rep["winner_row"]
     if base and win["best_s"]:
         rows.append(obs.artifact_metric(
@@ -110,6 +173,15 @@ def main(argv=None) -> int:
                          "not overwrite a curated store implicitly)")
     ap.add_argument("--keep-store", action="store_true",
                     help="record winners into the DEFAULT store")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="first learn measured per-op factors from the "
+                         "standing programs (attribution oracle) and "
+                         "rank with them — adds the "
+                         "autotune_rank_error_calibrated_* rows")
+    ap.add_argument("--calibration-root", default=None,
+                    help="calibration store dir (default with "
+                         "--calibrate: a throwaway, so the sweep never "
+                         "implicitly rewrites a curated store)")
     ap.add_argument("--out", default=None)
     ap.add_argument("--metrics", default=None)
     ap.add_argument("--trace", default=None)
@@ -123,10 +195,20 @@ def main(argv=None) -> int:
         tmp_store = tempfile.TemporaryDirectory(prefix="at_sweep_")
         os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = tmp_store.name
 
+    tmp_cal = None
+    if args.calibration_root:
+        os.environ["PADDLE_TPU_CALIBRATION_CACHE"] = os.path.abspath(
+            args.calibration_root)
+    elif args.calibrate:
+        tmp_cal = tempfile.TemporaryDirectory(prefix="at_calib_")
+        os.environ["PADDLE_TPU_CALIBRATION_CACHE"] = tmp_cal.name
+
     from paddle_tpu import observability as obs
     from paddle_tpu.autotune.measure import MockMeasurer, TimedMeasurer
 
     obs.enable_tracing()
+    if args.calibrate:
+        populate_calibration()
     if args.smoke:
         measurer = MockMeasurer()
         args.workloads = "bn_conv"
@@ -187,6 +269,8 @@ def main(argv=None) -> int:
             f.write(line + "\n")
     if tmp_store is not None:
         tmp_store.cleanup()
+    if tmp_cal is not None:
+        tmp_cal.cleanup()
     return 1 if problems else 0
 
 

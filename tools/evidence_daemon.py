@@ -259,11 +259,21 @@ CAPTURES = [
     # winner; the lstm_step_ms_reconciliation row settles the
     # 6.97-vs-9.89 ms discrepancy under one methodology-labeled run
     ("autotune_sweep",
-     [sys.executable, "tools/autotune_sweep.py",
+     [sys.executable, "tools/autotune_sweep.py", "--calibrate",
       "--out", os.path.join(OUT, "autotune_sweep_rows.json"),
       "--metrics", os.path.join(OUT, "autotune_sweep_metrics.json"),
       "--trace", os.path.join(OUT, "autotune_sweep_trace.json")],
      {}, 1800),
+    # per-op attribution (ISSUE 16): `paddle attribute` over the small
+    # LM with op-identity scopes threaded into a jax.profiler trace —
+    # on TPU the Perfetto events carry the pdop__<type>__u<uid> scopes
+    # and the parsed per-op table rides in the artifact; the CPU-oracle
+    # table is always attached as the fallback/cross-check
+    ("op_attribution",
+     [sys.executable, "-m", "paddle_tpu", "attribute", "small_lm",
+      "--json", "--profile", os.path.join(OUT, "trace_attribution"),
+      "--out", os.path.join(OUT, "op_attribution_rows.json")],
+     {}, 900),
     ("resnet_bs256",
      [sys.executable, "bench.py"],
      {"BENCH_MODEL": "resnet", "BENCH_BS": "256", "BENCH_ITERS": "10"},

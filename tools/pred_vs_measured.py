@@ -42,69 +42,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_fit_a_line():
-    import paddle_tpu as fluid
+def _models():
+    # builders moved to paddle_tpu/models/standing.py (ISSUE 16) so
+    # `paddle attribute` and this driver measure the SAME descs; the
+    # import is deferred because paddle_tpu pulls in jax
+    from paddle_tpu.models.standing import MODELS
 
-    x = fluid.layers.data(name="x", shape=[13])
-    y = fluid.layers.data(name="y", shape=[1])
-    pred = fluid.layers.fc(input=x, size=1)
-    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
-    rng = np.random.RandomState(0)
-    bs = 64
-    feed = {"x": rng.rand(bs, 13).astype(np.float32),
-            "y": rng.rand(bs, 1).astype(np.float32)}
-    return feed, [cost], bs
-
-
-def _build_recognize_digits():
-    import paddle_tpu as fluid
-
-    img = fluid.layers.data(name="img", shape=[1, 28, 28])
-    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-    c = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
-                            bias_attr=False)
-    b = fluid.layers.batch_norm(c, act="relu")
-    p = fluid.layers.pool2d(b, pool_size=2, pool_stride=2)
-    flat = fluid.layers.reshape(p, [-1, 8 * 12 * 12])
-    pred = fluid.layers.fc(flat, size=10, act="softmax")
-    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
-    rng = np.random.RandomState(1)
-    bs = 16
-    feed = {"img": rng.rand(bs, 1, 28, 28).astype(np.float32),
-            "label": rng.randint(0, 10, (bs, 1)).astype(np.int64)}
-    return feed, [loss], bs
-
-
-def _build_small_lm():
-    from paddle_tpu.models import transformer
-
-    S, V = 32, 128
-    loss = transformer.build_lm_train_program(
-        seq_len=S, vocab_size=V, dim=32, n_layers=2, n_heads=2,
-        dtype="float32", learning_rate=1e-2)
-    rng = np.random.RandomState(2)
-    bs = 4
-    toks = rng.randint(0, V, (bs, S, 1)).astype(np.int64)
-    feed = {"tokens": toks, "targets": np.roll(toks, -1, axis=1)}
-    return feed, [loss], bs
-
-
-def _build_lstm():
-    """The LSTM step program (ISSUE 14 satellite): the 6.97-vs-9.89 ms
-    family gets a standing predicted-vs-measured row — shares the
-    autotune workload's builder so `paddle tune lstm`, the sweep
-    artifact, and this accounting row all describe the SAME program."""
-    from paddle_tpu.autotune.workloads import _build_lstm as build
-
-    return build()
-
-
-MODELS = (("fit_a_line", _build_fit_a_line),
-          ("recognize_digits", _build_recognize_digits),
-          ("small_lm", _build_small_lm),
-          ("lstm", _build_lstm))
+    return MODELS
 
 
 def run_model(name, builder, steps, chip):
@@ -144,7 +88,8 @@ def main(argv=None) -> int:
     from paddle_tpu.analysis import cost as acost
 
     chip = acost.detect_chip()
-    models = MODELS[:1] if args.smoke else MODELS
+    all_models = _models()
+    models = all_models[:1] if args.smoke else all_models
     all_rows, reports = [], []
     # fluid.reset() wipes telemetry between models, so each model's rows
     # and trace window are collected right after its run; the snapshot

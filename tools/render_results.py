@@ -61,8 +61,8 @@ def main():
             ok_rows.append((utc, name, r))
 
     print("| capture | metric | value | unit | vs baseline | mfu "
-          "| p50/p99 ms | comm |")
-    print("|---|---|---|---|---|---|---|---|")
+          "| p50/p99 ms | comm | attribution |")
+    print("|---|---|---|---|---|---|---|---|---|")
     for utc, name, r in ok_rows:
         # serving rows (tools/serve_bench.py) carry request-latency
         # percentiles beside the throughput headline
@@ -79,10 +79,22 @@ def main():
                 f"{k} {((r.get('byte_ratio') or {}).get(k, ''))}"
                 for k in kinds)
             ctxt += " (static/actual bytes)" if ctxt else ""
+        # attribution/calibration rows (paddle attribute + the
+        # calibrated sweep re-rank): top op by measured share, or the
+        # raw-vs-calibrated rank pair
+        atxt = ""
+        if isinstance(r.get("by_type"), dict) and r.get("top_op"):
+            top = r["by_type"].get(r["top_op"]) or {}
+            share = top.get("share")
+            atxt = (f"top {r['top_op']} "
+                    f"{share * 100:.0f}%" if share is not None
+                    else f"top {r['top_op']}")
+        elif "raw_rank" in r:
+            atxt = f"raw rank {r['raw_rank']} -> {r.get('value')}"
         print(f"| {name} | {r.get('metric', r.get('mode', ''))} "
               f"| {r.get('value')} "
               f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
-              f"| {r.get('mfu', '')} | {ptxt} | {ctxt} |")
+              f"| {r.get('mfu', '')} | {ptxt} | {ctxt} | {atxt} |")
     if failed:
         print("\nFailed/empty captures:")
         for name, err in failed:

@@ -51,7 +51,16 @@ Three classes of rot this repo has actually accumulated:
      through): a tripwire, not an AST proof.  `tests/` are exempt —
      they corrupt checkpoints on purpose.
 
-  9. raw tuning-knob env reads outside ``paddle_tpu/autotune/`` — the
+  9. ``jax.named_scope`` outside the attribution layer — op identity
+     (``pdop__<type>__u<uid>``, ISSUE 16) has ONE mint:
+     ``observability/attribution.py::op_scope``.  A second named-scope
+     call site anywhere in ``paddle_tpu/`` or ``tools/`` either invents
+     a competing naming scheme the trace parser cannot see or re-wraps
+     ops the executor already scoped, corrupting the profile->desc
+     join.  Line-anchored tripwire; ``tests/`` exempt (they assert on
+     scope behaviour).
+
+  10. raw tuning-knob env reads outside ``paddle_tpu/autotune/`` — the
      autotuner (ISSUE 14) made PADDLE_TPU_FLASH_BQ/BK,
      PADDLE_TPU_BNCONV_*, PADDLE_TPU_PAGE_SIZE and friends an explicit
      OVERRIDE LAYER resolved (and validated) in
@@ -323,6 +332,43 @@ def _check_knob_env(root, dirpath, filenames, findings):
             pass
 
 
+# the op-identity mint guard: jax.named_scope (any alias form) outside
+# the attribution layer.  The pattern is assembled so this file does
+# not flag itself.
+_NAMED_SCOPE_RE = re.compile(r"\bnamed_" + r"scope\s*\(")
+_NAMED_SCOPE_DIRS = ("paddle_tpu", "tools")
+_NAMED_SCOPE_OK = {
+    os.path.join("paddle_tpu", "observability", "attribution.py"),
+}
+
+
+def _check_named_scope(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    top = "" if rel_dir == "." else rel_dir.split(os.sep)[0]
+    if top not in _NAMED_SCOPE_DIRS:
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel in _NAMED_SCOPE_OK or rel == os.path.join(
+                "tools", "repo_lint.py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _NAMED_SCOPE_RE.search(line):
+                        findings.append(
+                            f"named-scope outside the attribution "
+                            f"layer: {rel}:{i} (op identity has one "
+                            f"mint — observability/attribution.py "
+                            f"op_scope(); a second scheme corrupts "
+                            f"the profile->ProgramDesc join)")
+        except OSError:
+            pass
+
+
 # the PTV rule/doc drift guard: rule registrations in verifier.py vs
 # catalog rows in docs/analysis.md
 _RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
@@ -398,6 +444,7 @@ def lint(root: str):
         _check_perf_counter(root, dirpath, filenames, findings)
         _check_knob_env(root, dirpath, filenames, findings)
         _check_ckpt_writes(root, dirpath, filenames, findings)
+        _check_named_scope(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)
