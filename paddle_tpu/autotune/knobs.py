@@ -204,3 +204,34 @@ def paged_page_size(default: int = 16) -> int:
     if v is None:
         v = _site_winner("paged_attention", {}).get("page_size")
     return int(v or default)
+
+
+def speculation_k(default: int = 4) -> int:
+    """Speculative-decoding depth K (draft tokens proposed per round;
+    serving/speculative.py).  Trial override > PADDLE_TPU_SPEC_K
+    (validated positive int) > stored ``spec_decode`` winner >
+    `default`.  K trades one fused draft run + (K+1)-row verify against
+    up to K saved decode dispatches — the right value depends on the
+    measured accept rate, which is what ``paddle tune spec_decode``
+    measures."""
+    v = _trial_value("spec_decode.speculation_k")
+    if v is None:
+        v = _env_int("PADDLE_TPU_SPEC_K", "speculation depth in tokens")
+    if v is None:
+        v = _site_winner("spec_decode", {}).get("speculation_k")
+    return int(v or default)
+
+
+def spec_draft_layers(default: int) -> int:
+    """Draft-tower depth for self-speculation (the target's first N
+    blocks; serving/speculative.py).  Trial override >
+    PADDLE_TPU_SPEC_DRAFT_LAYERS (validated positive int) > stored
+    ``spec_decode`` winner > `default`.  Callers clamp to the target's
+    depth — deeper drafts raise accept rate and draft cost together."""
+    v = _trial_value("spec_decode.draft_layers")
+    if v is None:
+        v = _env_int("PADDLE_TPU_SPEC_DRAFT_LAYERS",
+                     "draft tower depth in layers")
+    if v is None:
+        v = _site_winner("spec_decode", {}).get("draft_layers")
+    return int(v or default)

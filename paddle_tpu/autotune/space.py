@@ -173,6 +173,25 @@ def paged_space(max_ctx: int = 1024) -> SearchSpace:
     ])
 
 
+def spec_decode_space(n_layers: int = 4,
+                      max_new: int = 12) -> SearchSpace:
+    """Speculative-decoding serve-loop space (ISSUE 18): proposal depth
+    K x draft tower depth.  K is bounded by the per-request new-token
+    budget (a K >= max_new round could never accept its tail) and the
+    draft must be strictly shallower than the target (equal depth is
+    the target itself — all cost, no speedup).  Defaults first: K=4 and
+    the half-depth draft, matching ``knobs.speculation_k`` /
+    ``spec_draft_layers``."""
+    ks = [k for k in (4, 2, 8, 1) if 1 <= k < max_new] or [1]
+    drafts = [d for d in (max(1, n_layers // 2), 1, n_layers - 1)
+              if 1 <= d < n_layers]
+    drafts = list(dict.fromkeys(drafts)) or [1]
+    return SearchSpace([
+        Choice("spec_decode.speculation_k", tuple(ks)),
+        Choice("spec_decode.draft_layers", tuple(drafts)),
+    ])
+
+
 def mlp_depth_space(depths: Sequence[int] = (16, 4, 1)) -> SearchSpace:
     """Depth-vs-width axis at ~constant hidden FLOPs (depth * width^2
     fixed): the op-COUNT workload.  The deepest stack is the default

@@ -516,6 +516,152 @@ class PagedDecodeWorkload:
         return _KernelRunner(fn, (q, k_pages, v_pages, pt, cl))
 
 
+class _ServeRunner:
+    """Serve-loop measurement runner: one step() = submit a FIXED
+    request set and drive the engine to drain.  Candidates change how
+    many device dispatches that takes (speculation depth, draft cost),
+    not how much work is requested — so per-step wall time compares
+    equal token output across the space."""
+
+    def __init__(self, engine, prompts, max_new):
+        self.engine = engine
+        self.prompts = prompts
+        self.max_new = int(max_new)
+
+    def step(self):
+        for p in self.prompts:
+            self.engine.submit(p, self.max_new)
+        for _ in range(100000):
+            if not self.engine.step():
+                break
+        self.engine.pop_finished()
+
+    def barrier(self):
+        pass  # generated tokens are host ints — drain IS the barrier
+
+    def close(self):
+        try:
+            self.engine._exe.close()
+        except Exception:
+            pass
+        self.engine = None
+
+
+class SpecDecodeWorkload:
+    """Speculative-decoding serve loop over (K, draft depth) — the
+    ISSUE 18 axes, resolved through ``knobs.speculation_k`` /
+    ``spec_draft_layers`` so the trial-override path the engine uses in
+    production is what the A/B proves.  The analytic prior prices one
+    drained serve of the fixed request set: a round costs K draft-layer
+    token passes plus a (K+1)-row verify over the full tower, and emits
+    E[accepted]+1 tokens under a geometric accept model whose per-token
+    probability rises with draft depth (a full-depth draft is the
+    target and accepts everything; the measured accept rate is what the
+    real trials then substitute for this guess)."""
+
+    kind = "kernel"
+    name = "spec_decode"
+
+    def __init__(self, vocab=50, dim=32, layers=4, heads=2, max_len=64,
+                 max_new=12, n_requests=6, accept_prob=0.6):
+        self.vocab, self.dim, self.layers = vocab, dim, layers
+        self.heads, self.max_len, self.max_new = heads, max_len, max_new
+        self.n_requests = n_requests
+        self.accept_prob = accept_prob
+
+    def space(self) -> _space.SearchSpace:
+        return _space.spec_decode_space(n_layers=self.layers,
+                                        max_new=self.max_new)
+
+    def site(self) -> dict:
+        return {"workload": self.name, "vocab": self.vocab,
+                "dim": self.dim, "layers": self.layers,
+                "heads": self.heads, "max_len": self.max_len,
+                "max_new": self.max_new, "dtype": "float32"}
+
+    def kernel_sites(self) -> Tuple:
+        return (("spec_decode", {},
+                 {"speculation_k": "spec_decode.speculation_k",
+                  "draft_layers": "spec_decode.draft_layers"}),)
+
+    def program_for(self, candidate):
+        return None  # serve loop: priced analytically
+
+    def _accept_prob(self, draft_layers: int) -> float:
+        """Per-drafted-token accept probability model: linear in draft
+        depth from `accept_prob` at one layer to 1.0 at full depth
+        (where the draft IS the target)."""
+        L = self.layers
+        if L <= 1:
+            return 1.0
+        frac = (L - draft_layers) / float(L - 1)
+        return 1.0 - (1.0 - self.accept_prob) * frac
+
+    def analytic_cost(self, candidate, spec) -> dict:
+        k = int(candidate.get("spec_decode.speculation_k", 4))
+        nd = int(candidate.get("spec_decode.draft_layers",
+                               max(1, self.layers // 2)))
+        D, L, V = self.dim, self.layers, self.vocab
+        p = min(self._accept_prob(nd), 0.999)
+        # expected tokens emitted per round: the accepted prefix + the
+        # verify row's own token (geometric, truncated at K)
+        emitted = (1.0 - p ** (k + 1)) / (1.0 - p)
+        rounds = self.n_requests * self.max_new / emitted
+        # per-token per-layer: qkvo (8 D^2) + mlp (16 D^2) FLOPs and an
+        # attention walk over the average live context
+        f_layer = 24.0 * D * D + 4.0 * (self.max_len / 2.0) * D
+        f_head = 2.0 * D * V
+        token_passes = k * nd + (k + 1) * L  # draft + verify per round
+        flops = rounds * (token_passes * f_layer
+                          + (k + 1) * f_head)
+        # bytes: weight streams per dispatch (the unrolled draft loop
+        # re-reads its nd layers each of the K steps) + the KV walk
+        wb_layer = 12.0 * D * D * 4
+        kv_row = 2.0 * (self.max_len / 2.0) * D * 4
+        bytes_ = rounds * (token_passes * (wb_layer + kv_row)
+                           + (k + 1) * D * V * 4)
+        return {"flops": flops, "bytes": bytes_, "dtype": "float32"}
+
+    def feasible(self, candidate, spec):
+        k = int(candidate.get("spec_decode.speculation_k", 4))
+        nd = int(candidate.get("spec_decode.draft_layers", 1))
+        if not 1 <= k < self.max_new:
+            return False, (f"speculation_k={k} outside [1, "
+                           f"{self.max_new}) for max_new={self.max_new}")
+        if not 1 <= nd < self.layers:
+            return False, (f"draft_layers={nd} must be in [1, "
+                           f"{self.layers}) — equal depth is the target")
+        return True, ""
+
+    def build_runner(self, candidate) -> _ServeRunner:
+        import paddle_tpu as fluid
+        from ..framework import unique_name
+        from ..framework.core import Program, program_guard
+        from ..models import transformer
+        from ..serving import ServingEngine
+
+        main, startup = Program(), Program()
+        with unique_name.guard(), program_guard(main, startup):
+            lm = transformer.DecoderLM(self.vocab, self.dim, self.layers,
+                                       self.heads, max_len=self.max_len,
+                                       dtype="float32")
+            tokens = fluid.layers.data("tokens",
+                                       shape=[self.max_len, 1],
+                                       dtype="int64")
+            lm.logits(tokens)
+            main.random_seed = 11
+            exe = fluid.Executor(fluid.default_place())
+            exe.run(startup)
+            # K and draft depth resolve through knobs under the active
+            # trial override — the production resolution path
+            eng = ServingEngine(lm, max_batch_size=3, page_size=16,
+                                scheduler="spec", name="tune_spec")
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, self.vocab, size=n).tolist()
+                   for n in (13, 6, 9, 16, 2, 11)][:self.n_requests]
+        return _ServeRunner(eng, prompts, self.max_new)
+
+
 # ---------------------------------------------------------------------------
 # saved-model workloads (`paddle tune <dir>`)
 
@@ -614,6 +760,7 @@ WORKLOADS: Dict[str, Callable[[], object]] = {
                        "layers": 2, "causal": True, "dtype_bytes": 4}),
     "bn_conv": BnConvWorkload,
     "paged_decode": PagedDecodeWorkload,
+    "spec_decode": SpecDecodeWorkload,
     "lstm": lambda: ProgramWorkload("lstm", _build_lstm, _lstm_space),
     "mlp_depth": MlpDepthWorkload,
 }

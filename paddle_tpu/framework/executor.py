@@ -125,7 +125,7 @@ def _prune_cache_dir(path: str, max_bytes: int):
 def _enable_compilation_cache():
     """Persistent XLA compilation cache: repeat processes (CLI runs, CI,
     the subprocess-isolated bench modes) reuse on-disk executables instead
-    of recompiling.  Default ON for every backend, bounded to
+    of recompiling.  Default ON for non-CPU backends, bounded to
     PADDLE_TPU_COMPILE_CACHE_MAX_MB (default 1024) by oldest-mtime
     eviction — the bound answers the tunneled-TPU concern that an
     unbounded executable store is an unbounded cost.  Override the
@@ -137,6 +137,20 @@ def _enable_compilation_cache():
     _cc_enabled = True
     try:
         import jax
+
+        # CPU: never enable the persistent cache.  DESERIALIZED XLA:CPU
+        # executables intermittently write non-finite garbage into
+        # donated buffers (reproduced on the serving KV pools: ~50% of
+        # processes corrupt once entries LOAD, sticky per process;
+        # fresh compile+store runs are 100% clean, with the integrity
+        # layer on or off — so the stored bytes are fine and no digest
+        # check can catch it; PADDLE_TPU_NO_COMPILE_CACHE=1 was the old
+        # per-run sidestep).  CPU compiles are cheap and in-process
+        # executables are reused anyway; TPU keeps the cache — its PJRT
+        # loader path is different and its 20-40s headline compiles are
+        # what the cache exists for.
+        if jax.default_backend() == "cpu":
+            return
 
         base = os.environ.get("PADDLE_TPU_COMPILE_CACHE") or os.path.join(
             os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
@@ -173,10 +187,9 @@ def _enable_compilation_cache():
         # off-CPU, executable serialization may ride a tunneled PJRT
         # plugin: store only compiles long enough that a one-time
         # serialization clearly pays for itself (the headline bench
-        # programs compile in 20-40s); CPU keeps the low threshold
-        min_secs = 1.0 if jax.default_backend() == "cpu" else 10.0
+        # programs compile in 20-40s); CPU never reaches here
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          min_secs)
+                          10.0)
         # integrity layer (compiler.py): entries are digest-sealed and
         # written tmp+rename; a corrupt/truncated entry is evicted and
         # recompiled on read instead of feeding XLA poisoned bytes (the

@@ -276,14 +276,19 @@ class DecoderLM:
         return tok
 
     def prefill_chunk(self, tokens, ctx_len, chunk_len, page_table, cache,
-                      page_size):
+                      page_size, all_tokens=False):
         """Append one chunked-prefill op (ops/attention_ops.py
         paged_prefill_chunk): materialize K/V for `tokens` [K,C,1] at
         context offset `ctx_len` [K,1] through `page_table`, return the
         argmax token [K] at each lane's last valid position (meaningful
         only on a lane's FINAL chunk; `chunk_len` [K,1] = 0 idles a
         lane).  The v2 engine's prefill quantum — interleaved with
-        decode inside one mixed program."""
+        decode inside one mixed program.
+
+        ``all_tokens=True`` returns (tok, chunk_tokens) where
+        chunk_tokens [K,C] is the greedy argmax after EVERY position —
+        the speculative VERIFY step: the op scores a whole drafted
+        continuation in one run (serving/speculative.py)."""
         if self._params is None:
             raise RuntimeError("build the tower with .logits() first")
         kpool, vpool = cache
@@ -294,13 +299,70 @@ class DecoderLM:
         ins.update({"CtxLen": [ctx_len.name], "ChunkLen": [chunk_len.name],
                     "PageTable": [page_table.name],
                     "KPool": [kpool.name], "VPool": [vpool.name]})
+        outs = {"NextToken": [tok.name], "KPoolOut": [kpool.name],
+                "VPoolOut": [vpool.name]}
+        ctok = None
+        if all_tokens:
+            C = int(tokens.shape[-2])  # [.., C, 1] token payload
+            ctok = helper.create_tmp_variable("int64", shape=(-1, C),
+                                              stop_gradient=True)
+            outs["ChunkTokens"] = [ctok.name]
         helper.append_op(
-            "paged_prefill_chunk", inputs=ins,
-            outputs={"NextToken": [tok.name], "KPoolOut": [kpool.name],
+            "paged_prefill_chunk", inputs=ins, outputs=outs,
+            attrs={"n_heads": self.n_heads, "page_size": int(page_size),
+                   "eps": 1e-5, "all_tokens": int(bool(all_tokens))})
+        if all_tokens:
+            return tok, ctok
+        return tok
+
+    def spec_draft(self, cache, token, ctx_len, spec_len, page_table,
+                   page_size, k_steps):
+        """Append a paged_spec_draft op: `k_steps` chained greedy decode
+        steps of THIS tower (the draft — see truncated()) over the
+        target's pools, returning the drafted continuation [B, k_steps]
+        int64.  `spec_len` [B,1] caps per-slot drafting (0 idles a
+        slot).  The proposal half of speculative decoding."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        kpool, vpool = cache
+        helper = LayerHelper("paged_spec_draft")
+        drafted = helper.create_tmp_variable(
+            "int64", shape=(-1, int(k_steps)), stop_gradient=True)
+        ins = self._decode_inputs(token)
+        ins.update({"CtxLen": [ctx_len.name], "SpecLen": [spec_len.name],
+                    "PageTable": [page_table.name],
+                    "KPool": [kpool.name], "VPool": [vpool.name]})
+        helper.append_op(
+            "paged_spec_draft", inputs=ins,
+            outputs={"Drafted": [drafted.name], "KPoolOut": [kpool.name],
                      "VPoolOut": [vpool.name]},
             attrs={"n_heads": self.n_heads, "page_size": int(page_size),
-                   "eps": 1e-5})
-        return tok
+                   "eps": 1e-5, "k_steps": int(k_steps)})
+        return drafted
+
+    def truncated(self, n_layers):
+        """A DEPTH-TRUNCATED view of this model: the first `n_layers`
+        blocks plus the shared embedding/position/final-LN/head — the
+        self-speculative DRAFT (ISSUE 18).  The view owns NO parameters
+        of its own (its _params alias this model's), so draft layer i
+        computes exactly target layer i and the two towers share one KV
+        pool (the draft touches only pool layers < n_layers).
+
+        Policy: tools/repo_lint.py allows calls ONLY from
+        serving/speculative.py — the draft has one mint, like
+        PartitionSpec, so accept/reject exactness is auditable in one
+        place."""
+        if self._params is None:
+            raise RuntimeError("build the tower with .logits() first")
+        if not 1 <= int(n_layers) <= self.n_layers:
+            raise ValueError(
+                f"draft depth {n_layers} not in [1, {self.n_layers}]")
+        draft = DecoderLM(self.vocab_size, self.dim, int(n_layers),
+                          self.n_heads, self.max_len,
+                          mlp_ratio=self.mlp_ratio, dtype=self.dtype)
+        head = 2 + self._PER_LAYER * int(n_layers)
+        draft._params = self._params[:head] + self._params[-3:]
+        return draft
 
     def page_copy(self, src, dst, cache):
         """Append a paged_page_copy op: physical page `src` [M,1] ->

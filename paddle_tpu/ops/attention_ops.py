@@ -361,16 +361,26 @@ def paged_prefill_chunk(ctx, ins, attrs):
     prefix-cache pages), ChunkLen [K,1] (valid tokens this chunk; 0 =
     idle lane, all writes land in the null page), PageTable [K,maxp],
     KPool/VPool, plus the gpt_decode parameter slots.  Attrs: n_heads,
-    page_size, eps.  Outputs: NextToken [K] int64 (argmax at each lane's
-    LAST valid chunk position — the first generated token when this
-    chunk completes the prompt, garbage otherwise; idle lanes emit 0),
-    KPoolOut/VPoolOut.
+    page_size, eps, all_tokens.  Outputs: NextToken [K] int64 (argmax at
+    each lane's LAST valid chunk position — the first generated token
+    when this chunk completes the prompt, garbage otherwise; idle lanes
+    emit 0), KPoolOut/VPoolOut, and with ``all_tokens=1`` ChunkTokens
+    [K,C] int64 — the greedy argmax after EVERY chunk position (0 past
+    ChunkLen).  ChunkTokens is the speculative VERIFY read (ISSUE 18):
+    row c is the target's next token given the context through chunk
+    position c, so one chunk run scores a whole drafted continuation.
+
+    Attention runs the multi-query Pallas page walk
+    (pallas_kernels/paged_attention.paged_attention_mq) when eligible;
+    the dense page-table gather below is the CPU/interpret oracle,
+    tested for parity.
 
     paged_decode_step is exactly this op at C=1 — kept separate so the
     steady-state decode program never pays chunk-width compute."""
     import jax
     import jax.numpy as jnp
 
+    from .pallas_kernels import paged_attention as pa
     from .transformer_ops import _lm_fns, _prompt_2d, stable_argmax
 
     nh = int(attrs["n_heads"])
@@ -405,6 +415,10 @@ def paged_prefill_chunk(ctx, ins, attrs):
     hold = {"k": kpool, "v": vpool}
     pages_f, offs_f = page.reshape(-1), off.reshape(-1)
     kpos = jnp.arange(maxp * ps)
+    use_kernel = pa.paged_dispatch_ok(ctx, page_size=ps, head_dim=fns.dh)
+    # rows past ChunkLen attend through the mq contract's key bound
+    # (kp < attend_len); >= 1 keeps every row's normalizer positive
+    attend_len = jnp.maximum(ctx0 + clen, 1)
 
     def attend(i, q, k, v):
         rows = lambda a: a.transpose(0, 2, 1, 3).reshape(K * C, nh, fns.dh)
@@ -412,10 +426,18 @@ def paged_prefill_chunk(ctx, ins, attrs):
                                        rows(k))
         hold["v"] = _paged_pools_write(hold["v"], i, pages_f, offs_f,
                                        rows(v))
+        if use_kernel:
+            # multi-query ragged page walk: no gather, no pool copy —
+            # valid rows (c < ChunkLen) match the dense oracle exactly;
+            # rows past ChunkLen differ only where both are garbage
+            return pa.paged_attention_mq(q, hold["k"][i], hold["v"][i],
+                                         pt, attend_len, ctx0,
+                                         scale=scale)
         # dense gather over the slot's whole paged window (the
         # paged_attention_ref idiom: f32 scores, -1e30 mask) — cached
         # prefix, earlier chunks, and this chunk attend uniformly, with
-        # causality enforced by key-position <= query-position
+        # causality enforced by key-position <= query-position.  This is
+        # the CPU/interpret ORACLE for the mq kernel above.
         dense = lambda pool: pool[i][pt].transpose(0, 2, 1, 3, 4).reshape(
             K, nh, maxp * ps, fns.dh)
         kd, vd = dense(hold["k"]), dense(hold["v"])
@@ -434,7 +456,102 @@ def paged_prefill_chunk(ctx, ins, attrs):
         axis=1)  # [K,1,D]
     nxt = stable_argmax(fns.head_logits(last), jnp.int32)
     nxt = jnp.where(clen > 0, nxt, 0).astype(jnp.int64)
-    return {"NextToken": [nxt], "KPoolOut": [hold["k"]],
+    out = {"NextToken": [nxt], "KPoolOut": [hold["k"]],
+           "VPoolOut": [hold["v"]]}
+    if int(attrs.get("all_tokens", 0)):
+        ctoks = stable_argmax(fns.head_logits_all(x), jnp.int32)  # [K,C]
+        out["ChunkTokens"] = [jnp.where(valid, ctoks, 0).astype(jnp.int64)]
+    return out
+
+
+@register_op("paged_spec_draft", grad=None,
+             non_diff_inputs=("Tokens", "CtxLen", "SpecLen", "PageTable"))
+def paged_spec_draft(ctx, ins, attrs):
+    """K chained DRAFT decode steps in ONE program — the proposal half
+    of speculative decoding (ISSUE 18; serving/speculative.py).
+
+    The parameter slots carry the DRAFT tower: a depth-truncated prefix
+    of the target (first n layers + the target's embedding/position/
+    final-LN/head), so draft layer i IS target layer i and the K/V the
+    draft writes at pool layer i are the values the target would write
+    there.  The pools fed in are therefore the TARGET's pools — layers
+    >= the draft depth are simply never touched, and no second KV cache
+    (or draft prefill) exists anywhere.
+
+    Inputs: Tokens [N,1] int64 (each slot's last emitted target token —
+    not yet in the cache), CtxLen [N,1] (positions materialized),
+    SpecLen [N,1] (tokens to draft this round; 0 idles the slot — its
+    writes land in the null page and it emits 0s), PageTable [N,maxp],
+    KPool/VPool (target pools), plus the DRAFT parameter slots.
+    Attrs: n_heads, page_size, eps, k_steps.
+    Outputs: Drafted [N, k_steps] int64 (greedy draft continuation;
+    column k is garbage where k >= SpecLen), KPoolOut/VPoolOut.
+
+    Draft step k embeds the previous token at position CtxLen+k, writes
+    its draft-layer K/V through the page table (the host grew pages for
+    the whole speculative window first), attends over the paged context
+    and emits the next greedy draft token.  Rejected positions are
+    overwritten by the verify chunk before they can become visible —
+    the same safety argument as prompt pad tails."""
+    import jax.numpy as jnp
+
+    from .pallas_kernels import paged_attention as pa
+    from .transformer_ops import _lm_fns, stable_argmax
+
+    nh = int(attrs["n_heads"])
+    ps = int(attrs["page_size"])
+    eps = float(attrs.get("eps", 1e-5))
+    K = int(attrs["k_steps"])
+
+    tok = _squeeze_feed(ins["Tokens"][0], jnp.int32)
+    ctxl = _squeeze_feed(ins["CtxLen"][0], jnp.int32)
+    slen = _squeeze_feed(ins["SpecLen"][0], jnp.int32)
+    pt = ins["PageTable"][0].astype(jnp.int32)
+    kpool, vpool = ins["KPool"][0], ins["VPool"][0]
+
+    fns = _lm_fns(ins, nh, eps)
+    emb = ins["Emb"][0]
+    cdt = emb.dtype
+    scale = 1.0 / (fns.dh ** 0.5)
+    maxp = pt.shape[1]
+    use_kernel = pa.paged_dispatch_ok(ctx, page_size=ps, head_dim=fns.dh)
+
+    hold = {"k": kpool, "v": vpool}
+    drafted = []
+    # K is small (the speculation depth knob) — unrolled, like the layer
+    # walk, so XLA fuses the whole proposal loop into one executable
+    for k in range(K):
+        act = k < slen
+        p_abs = ctxl + k
+        page = jnp.take_along_axis(
+            pt, jnp.minimum(p_abs // ps, maxp - 1)[:, None], axis=1)[:, 0]
+        page = jnp.where(act, page, 0)
+        off = p_abs % ps
+        attend_len = jnp.where(act, p_abs + 1, 1)
+        p_row = jnp.minimum(p_abs, fns.pos.shape[0] - 1)
+        xt = emb[tok][:, None, :] + jnp.take(
+            fns.pos, p_row, axis=0).astype(cdt)[:, None, :]  # [N,1,D]
+
+        def attend(i, q, k_, v_, page=page, off=off,
+                   attend_len=attend_len):
+            hold["k"] = _paged_pools_write(hold["k"], i, page, off,
+                                           k_[:, :, 0])
+            hold["v"] = _paged_pools_write(hold["v"], i, page, off,
+                                           v_[:, :, 0])
+            fn = pa.paged_attention if use_kernel else pa.paged_attention_ref
+            out = fn(q[:, :, 0], hold["k"][i], hold["v"][i], pt,
+                     attend_len, scale=scale)
+            return out[:, :, None, :]
+
+        x = xt
+        for i in range(fns.L):
+            x = fns.block(i, x, attend)
+        nxt = stable_argmax(fns.head_logits(x), jnp.int32)
+        tok = jnp.where(act, nxt, 0)
+        drafted.append(tok)
+
+    out = jnp.stack(drafted, axis=1).astype(jnp.int64)  # [N,K]
+    return {"Drafted": [out], "KPoolOut": [hold["k"]],
             "VPoolOut": [hold["v"]]}
 
 
@@ -659,6 +776,30 @@ def _paged_prefill_chunk_cost(ins, outs, attrs):
 
 
 register_cost("paged_prefill_chunk", _paged_prefill_chunk_cost)
+
+
+def _paged_spec_draft_cost(ins, outs, attrs):
+    """k_steps chained decode steps over the DRAFT depth: the layer
+    count is len(WQ) (the truncated tower), NOT KPool's layer dim (the
+    target's pools are fed in but only the draft prefix is touched)."""
+    emb = ins.get("Emb", [None])[0]
+    kpool = ins.get("KPool", [None])[0]
+    pt = ins.get("PageTable", [None])[0]
+    wq = ins.get("WQ", [])
+    if emb is None or kpool is None or len(kpool.shape) != 5 or not wq:
+        return {}
+    vocab, d = emb.shape
+    _, _, n_heads, page, dh = kpool.shape
+    n_layers = len(wq)
+    n = pt.shape[0] if pt is not None and len(pt.shape) == 2 else 1
+    max_ctx = (pt.shape[1] * page if pt is not None
+               and len(pt.shape) == 2 else page)
+    k_steps = int(attrs.get("k_steps", 1))
+    per_layer = 24 * n * d * d + 4 * n * n_heads * dh * max_ctx
+    return {"flops": k_steps * (n_layers * per_layer + 2 * n * d * vocab)}
+
+
+register_cost("paged_spec_draft", _paged_spec_draft_cost)
 
 
 def _paged_page_copy_cost(ins, outs, attrs):

@@ -66,6 +66,15 @@ def _lm_fns(ins, nh: int, eps: float):
         return (x[:, -1].astype(jnp.float32) @
                 ins["WHead"][0].astype(jnp.float32))
 
+    def head_logits_all(x):
+        """Final LN + LM head on EVERY position, in f32: [N,t,D] ->
+        [N,t,V].  The speculative-verify read of the chunk op: one
+        forward scores the greedy continuation after each prefix.  LN
+        and the head matmul are position-wise, so row t here equals
+        head_logits() of the length-(t+1) slice exactly."""
+        x = ln(x, ins["LnfS"][0], ins["LnfB"][0])
+        return x.astype(jnp.float32) @ ins["WHead"][0].astype(jnp.float32)
+
     def prefill(tokens, T, use_flash=False, flash_interpret=False):
         """Causal self-attention over the prompt, caching K/V into the
         first P slots of [L,N,nh,T,dh] buffers.  Returns (last-position
@@ -138,6 +147,7 @@ def _lm_fns(ins, nh: int, eps: float):
     # own paged-cache attend instead of the contiguous-cache ones above
     return SimpleNamespace(prefill=prefill, decode_step=decode_step,
                            block=block, head_logits=head_logits,
+                           head_logits_all=head_logits_all,
                            L=L, D=D, dh=dh, pos=pos)
 
 

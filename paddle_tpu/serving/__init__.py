@@ -16,13 +16,20 @@ sense — a shared device, a request queue, and an engine loop:
                  mixed chunked-prefill+decode / COW page-copy) programs
                  over a DecoderLM and runs one Executor step per engine
                  iteration
+  speculative.py — SpeculativeDecoder (engine mode "spec"): depth-
+                 truncated self-draft + one-shot chunk verify + exact
+                 greedy accept, token-identical to v2 (ISSUE 18)
+  router.py    — ReplicaRouter: N engines behind hbm_report()-gated
+                 admission and analyzer-predicted placement
 
-Benchmarked by tools/serve_bench.py (--scheduler {fifo,v2,ab});
+Benchmarked by tools/serve_bench.py (--scheduler {fifo,v2,spec,ab});
 documented in docs/serving.md.
 """
 
 from .engine import ServingEngine  # noqa: F401
 from .kv_cache import (PageAllocator, PagedKVCache,  # noqa: F401
                        PrefixCache, page_size_from_env, pages_needed)
+from .router import ReplicaRouter  # noqa: F401
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         PreemptiveScheduler, Request)
+from .speculative import SpeculativeDecoder, build_draft_lm  # noqa: F401

@@ -37,8 +37,17 @@ requeues the lowest-priority request, whose resume (re-prefill of
 prompt + generated-so-far) reproduces the uninterrupted greedy output
 token-for-token.
 
+``scheduler="spec"`` — v2 plus speculative decoding (ISSUE 18).  The
+admission / chunked-prefill / preemption machinery is v2's verbatim;
+only the steady-state decode step is replaced by a draft→verify→accept
+round (serving/speculative.py): a depth-truncated self-draft proposes K
+tokens per slot in one fused program, one ``all_tokens`` chunk run
+scores all K+1 positions, and the host accept walk emits only TARGET
+tokens — so ``spec`` stays token-identical to ``v2`` while emitting up
+to K+1 tokens per round.
+
 Everything on-device is deterministic greedy argmax, so the engine's
-output must exactly reproduce the full-prefix tower oracle in BOTH
+output must exactly reproduce the full-prefix tower oracle in ALL
 modes — that is the serving correctness contract tests/test_serving.py
 enforces.
 """
@@ -76,6 +85,8 @@ class ServingEngine:
                  chunk_lanes: Optional[int] = None,
                  watermark_pages: Optional[int] = None,
                  prefix_caching: bool = True,
+                 spec_k: Optional[int] = None,
+                 spec_draft_layers: Optional[int] = None,
                  name: Optional[str] = None):
         """`lm` is a DecoderLM whose tower is already built (.logits())
         and whose parameters are initialized in the global scope (the
@@ -104,10 +115,14 @@ class ServingEngine:
         if lm._params is None:
             raise RuntimeError("build the model tower with .logits() "
                                "before constructing a ServingEngine")
-        if scheduler not in ("fifo", "v2"):
-            raise ValueError(f"scheduler={scheduler!r}: use 'fifo' or 'v2'")
+        if scheduler not in ("fifo", "v2", "spec"):
+            raise ValueError(f"scheduler={scheduler!r}: use 'fifo', 'v2' "
+                             "or 'spec'")
         self.lm = lm
         self.mode = scheduler
+        # "spec" = the full v2 machinery + speculative steady state
+        self._v2like = scheduler in ("v2", "spec")
+        self._spec = None  # constructed last (its programs need the pools)
         self.eos_id = int(eos_id)
         self.num_slots = int(max_batch_size)
         self.page_size = int(page_size if page_size is not None
@@ -140,7 +155,7 @@ class ServingEngine:
 
         self._mixed_prog = None
         self._copy_prog = None
-        if self.mode == "v2":
+        if self._v2like:
             self.chunk_size = int(chunk_size if chunk_size is not None
                                   else min(32, lm.max_len))
             self.chunk_lanes = int(chunk_lanes if chunk_lanes is not None
@@ -158,7 +173,7 @@ class ServingEngine:
         self._scope.set(f"{self._cache_name}.v", np.zeros(pool_shape, dt))
 
         self._prefill_progs: Dict[int, tuple] = {}  # bucket -> (prog, fetch)
-        if self.mode == "v2":
+        if self._v2like:
             if watermark_pages is None:
                 watermark_pages = self._default_watermark()
             self.scheduler = PreemptiveScheduler(
@@ -184,9 +199,15 @@ class ServingEngine:
         self.counters = MirroredCounters(
             {"prefill_computed": 0, "prefill_cached": 0,
              "cow_copies": 0, "peak_stranded": 0,
-             "mixed_steps": 0, "decode_steps": 0},
+             "mixed_steps": 0, "decode_steps": 0,
+             "spec_rounds": 0, "spec_drafted": 0,
+             "spec_accepted": 0, "spec_emitted": 0},
             family="serve_counters", engine=self.name,
             scheduler=self.mode)
+        if self.mode == "spec":
+            from .speculative import SpeculativeDecoder
+            self._spec = SpeculativeDecoder(self, k=spec_k,
+                                            draft_layers=spec_draft_layers)
 
     # ------------------------------------------------------------------
     def _build_v2_programs(self):
@@ -415,7 +436,7 @@ class ServingEngine:
 
     def _step_v2(self) -> bool:
         now = self._clock()
-        with _TRC.span("serve.admit", scheduler="v2") as sp:
+        with _TRC.span("serve.admit", scheduler=self.mode) as sp:
             sp.note(admitted=len(self.scheduler.admit(now=now)))
         self._run_copies()
 
@@ -442,9 +463,15 @@ class ServingEngine:
             return self.scheduler.outstanding() > 0
 
         if not lanes:
-            # steady state: the plain decode program, chunk-width free
-            self._decode()
-            self.counters["decode_steps"] += 1
+            if self._spec is not None:
+                # steady state, spec mode: one draft→verify→accept round
+                # emits >= 1 target token per slot (speculative.py)
+                self._spec.decode_round(decoding)
+                self.counters["spec_rounds"] += 1
+            else:
+                # steady state: the plain decode program, chunk-width free
+                self._decode()
+                self.counters["decode_steps"] += 1
             self._steps += 1
             return self.scheduler.outstanding() > 0
 
@@ -514,7 +541,7 @@ class ServingEngine:
         """One engine iteration; returns True while work remains.  FIFO:
         admit + whole-prompt prefill, then one decode step.  v2: admit
         (+ COW copies), then ONE mixed chunked-prefill/decode program."""
-        if self.mode == "v2":
+        if self._v2like:
             alive = self._step_v2()
         else:
             with _TRC.span("serve.admit", scheduler="fifo") as sp:
@@ -570,6 +597,8 @@ class ServingEngine:
             out["mixed"] = self._mixed_prog
         if self._copy_prog is not None:
             out["page_copy"] = self._copy_prog
+        if self._spec is not None:
+            out.update(self._spec.programs())
         for b, (prog, _) in sorted(self._prefill_progs.items()):
             out[f"prefill_{b}"] = prog
         return out

@@ -79,6 +79,11 @@ class Request:
         self.preemptions = 0
         self.cached_prefill_tokens = 0
         self.computed_prefill_tokens = 0
+        # speculative decoding stats (engine mode "spec"): drafts
+        # offered to / accepted by the verify step for THIS request —
+        # the honest per-request accept rate the bench reports
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # timing (engine clock): admission, first token, completion
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None

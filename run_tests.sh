@@ -36,6 +36,10 @@ env JAX_PLATFORMS=cpu python tools/pred_vs_measured.py --smoke > /dev/null \
 # also proves memory-infeasible candidates never reach a trial
 env JAX_PLATFORMS=cpu python -m paddle_tpu tune gpt_small --smoke \
     || { echo "autotune smoke failed (rc=$?)"; exit 1; }
+# the ISSUE 18 speculation axes (speculation_k x draft_layers) ride the
+# same loop: rank by the cost model, measure the survivors, persist
+env JAX_PLATFORMS=cpu python -m paddle_tpu tune spec_decode --smoke \
+    || { echo "spec_decode autotune smoke failed (rc=$?)"; exit 1; }
 
 # attribution smoke + regression sentinel (docs/observability.md ISSUE
 # 16): `paddle attribute` runs the deterministic CPU segment oracle
@@ -96,6 +100,32 @@ for p in "$serve_progs"/*.json; do
     JAX_PLATFORMS=cpu python -m paddle_tpu lint "$p" > /dev/null \
         || { echo "serving program lint failed: $p"; exit 1; }
 done
+
+# speculative-decoding smoke (docs/serving.md ISSUE 18): paired
+# spec-vs-v2 run with the verifier armed over the draft/verify programs
+# — outputs must be token-identical (every emitted token is a TARGET
+# token) and at least one fused-draft round must actually fire — then
+# the same program lint over the engine + spec programs
+spec_progs=$(mktemp -d)
+trap 'rm -rf "$serve_progs" "$serve_tele" "$spec_progs"' EXIT
+env JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=1 \
+    python tools/cache_guard.py --attempts 3 --fresh-dir "$spec_progs" -- \
+    python tools/serve_bench.py --smoke \
+    --scheduler spec --save-programs "$spec_progs" > /dev/null \
+    || { echo "speculative serve smoke failed (rc=$?)"; exit 1; }
+for p in "$spec_progs"/*.json; do
+    JAX_PLATFORMS=cpu python -m paddle_tpu lint "$p" > /dev/null \
+        || { echo "speculative program lint failed: $p"; exit 1; }
+done
+
+# replica-router smoke (docs/serving.md ISSUE 18): 2 replicas vs the
+# single wide engine at the same offered load — every request completes
+# on both sides, the analyzer placement spreads requests over both
+# replicas, and each replica's pool drains leak-free
+env JAX_PLATFORMS=cpu \
+    python tools/cache_guard.py --attempts 3 -- \
+    python tools/serve_bench.py --smoke --scheduler router > /dev/null \
+    || { echo "router serve smoke failed (rc=$?)"; exit 1; }
 
 python -m pytest tests/ -q "$@"
 

@@ -774,6 +774,51 @@ def test_repo_lint_page_table_mutation_guard(tmp_path):
                    if "page-table" in f)
 
 
+def test_repo_lint_truncated_mint_guard(tmp_path):
+    """`.truncated(` outside serving/speculative.py is a finding — the
+    draft view shares the target's weights and KV pools, and only
+    build_draft_lm owns that contract (ISSUE 18).  The speculative
+    module itself and anything outside paddle_tpu//tools are exempt."""
+    rl = _repo_lint_module()
+
+    serving = tmp_path / "paddle_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "__init__.py").write_text("")
+    (serving / "__init__.py").write_text("")
+    (serving / "speculative.py").write_text(
+        "draft = lm.truncated(n_layers)\n")
+    assert rl.lint(str(tmp_path)) == []
+    (serving / "engine.py").write_text(
+        "self.draft = self.lm.truncated(2)\n")
+    findings = [f for f in rl.lint(str(tmp_path))
+                if "draft-model mint" in f]
+    assert len(findings) == 1 and "engine.py:1" in findings[0]
+    # tests/ (any dir outside paddle_tpu + tools) stay exempt so
+    # oracle tests can build truncated references directly
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_x.py").write_text(
+        "ref = lm.truncated(1)\n")
+    assert not any("tests" in f for f in rl.lint(str(tmp_path))
+                   if "draft-model mint" in f)
+
+
+def test_repo_lint_spec_knob_env_guard(tmp_path):
+    """Raw reads of the speculation knobs outside autotune/ are
+    findings; plain exports (os.environ[...] = ...) are the knob
+    layer's input side and stay exempt (ISSUE 18)."""
+    rl = _repo_lint_module()
+
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        'k = int(os.environ.get("PADDLE_TPU_SPEC_K", "4"))\n'
+        'os.environ["PADDLE_TPU_SPEC_DRAFT_LAYERS"] = "1"\n')
+    findings = [f for f in rl.lint(str(tmp_path))
+                if "tuning-knob env read" in f]
+    assert len(findings) == 1 and "mod.py:1" in findings[0]
+
+
 # ---------------------------------------------------------------------------
 # static cost model (analysis/cost.py)
 

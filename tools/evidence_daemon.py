@@ -241,6 +241,22 @@ CAPTURES = [
       "--trace", os.path.join(OUT, "serve_v2_trace.json"),
       "--metrics", os.path.join(OUT, "serve_v2_metrics.json")],
      {"SERVE_SLOTS": "64", "SERVE_REQUESTS": "96"}, 900),
+    # speculative decoding A/B (ISSUE 18): draft-propose/verify-accept
+    # vs autoregressive v2 at identical Poisson load, paired runs and
+    # medians, exact greedy token identity checked per repeat, accept
+    # rate in the artifact; the bench's own CPU-tuned defaults (deep
+    # model, decode-heavy mix, K/draft via the knob env) ride along
+    ("serve_spec",
+     [sys.executable, "tools/serve_bench.py", "--scheduler", "spec",
+      "--trace", os.path.join(OUT, "serve_spec_trace.json"),
+      "--metrics", os.path.join(OUT, "serve_spec_metrics.json")],
+     {}, 900),
+    # replica scale-out (ISSUE 18): ReplicaRouter over right-sized
+    # replicas vs one pool-starved wide engine, same per-device pool
+    # and offered load, median-of-3 paired runs
+    ("serve_router",
+     [sys.executable, "tools/serve_bench.py", "--scheduler", "router"],
+     {}, 900),
     # predicted-vs-measured on chip (ISSUE 13 / ROADMAP #3+#5): the
     # static cost/memory model's error ratios for the book models and
     # the small LM, measured against real step time and XLA's on-chip

@@ -61,14 +61,22 @@ def main():
             ok_rows.append((utc, name, r))
 
     print("| capture | metric | value | unit | vs baseline | mfu "
-          "| p50/p99 ms | comm | attribution |")
-    print("|---|---|---|---|---|---|---|---|---|")
+          "| p50/p99 ms | accept | comm | attribution |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
     for utc, name, r in ok_rows:
         # serving rows (tools/serve_bench.py) carry request-latency
         # percentiles beside the throughput headline
         pct = r.get("percentiles") or {}
         ptxt = (f"{pct.get('p50_ms', '')}/{pct.get('p99_ms', '')}"
                 if pct else "")
+        # speculative-decoding rows (--scheduler spec) publish the
+        # measured accept rate beside the speedup — the speedup claim
+        # is only as honest as this number
+        acc = r.get("accept_rate")
+        if acc is None and r.get("unit") == "frac" \
+                and "accept" in str(r.get("metric", "")):
+            acc = r.get("value")
+        acctxt = f"{acc:.0%}" if isinstance(acc, (int, float)) else ""
         # comm_profile rows (tools/hlo_analysis.py comm): per-kind
         # static-vs-actual collective breakdown, compacted
         ctxt = ""
@@ -94,7 +102,8 @@ def main():
         print(f"| {name} | {r.get('metric', r.get('mode', ''))} "
               f"| {r.get('value')} "
               f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
-              f"| {r.get('mfu', '')} | {ptxt} | {ctxt} | {atxt} |")
+              f"| {r.get('mfu', '')} | {ptxt} | {acctxt} | {ctxt} "
+              f"| {atxt} |")
     if failed:
         print("\nFailed/empty captures:")
         for name, err in failed:

@@ -295,7 +295,11 @@ def _check_ckpt_writes(root, dirpath, filenames, findings):
 # override (and route the read through autotune/knobs.py).
 _KNOB_ENV_RE = re.compile(
     r"os\.environ\b[^\n]*PADDLE_TPU_(?:FLASH_|BNCONV_|PAGE_SIZE"
-    r"|AUTOTUNE\b)")
+    r"|AUTOTUNE\b|SPEC_K\b|SPEC_DRAFT_LAYERS)")
+# plain assignments are the EXPORT side of the knob layer (a bench
+# pinning its config so knobs.py resolves it for the whole process) —
+# only raw reads bypass validation/precedence and get flagged
+_KNOB_ENV_WRITE_RE = re.compile(r"os\.environ\[[^\]]+\]\s*=")
 _KNOB_ENV_DIRS = ("paddle_tpu", "tools")
 _KNOB_ENV_OK_DIR = os.path.join("paddle_tpu", "autotune")
 
@@ -320,7 +324,8 @@ def _check_knob_env(root, dirpath, filenames, findings):
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
                 for i, line in enumerate(f, 1):
-                    if _KNOB_ENV_RE.search(line):
+                    if _KNOB_ENV_RE.search(line) \
+                            and not _KNOB_ENV_WRITE_RE.search(line):
                         findings.append(
                             f"raw tuning-knob env read: {rel}:{i} "
                             f"(resolve through paddle_tpu/autotune/"
@@ -365,6 +370,48 @@ def _check_named_scope(root, dirpath, filenames, findings):
                             f"mint — observability/attribution.py "
                             f"op_scope(); a second scheme corrupts "
                             f"the profile->ProgramDesc join)")
+        except OSError:
+            pass
+
+
+# the draft-model mint guard: DecoderLM.truncated() outside the
+# speculative decoder.  The truncated view SHARES the target's
+# parameters and KV pools — a second caller holding one across an
+# unrelated engine build is silent weight aliasing.  serving/
+# speculative.py:build_draft_lm is the one mint (it resolves the
+# draft-depth knob and owns the sharing contract); tests/ are exempt
+# by scope (the walk only covers paddle_tpu/ and tools/).  Assembled
+# so this file does not flag itself.
+_TRUNCATED_RE = re.compile(r"\.trunc" + r"ated\s*\(")
+_TRUNCATED_DIRS = ("paddle_tpu", "tools")
+_TRUNCATED_OK = {
+    os.path.join("paddle_tpu", "serving", "speculative.py"),
+}
+
+
+def _check_truncated(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    top = "" if rel_dir == "." else rel_dir.split(os.sep)[0]
+    if top not in _TRUNCATED_DIRS:
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel in _TRUNCATED_OK or rel == os.path.join(
+                "tools", "repo_lint.py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _TRUNCATED_RE.search(line):
+                        findings.append(
+                            f"draft-model mint outside the speculative "
+                            f"decoder: {rel}:{i} (DecoderLM.truncated "
+                            f"shares target weights and KV pools — "
+                            f"serving/speculative.py build_draft_lm is "
+                            f"the one mint that owns that contract)")
         except OSError:
             pass
 
@@ -445,6 +492,7 @@ def lint(root: str):
         _check_knob_env(root, dirpath, filenames, findings)
         _check_ckpt_writes(root, dirpath, filenames, findings)
         _check_named_scope(root, dirpath, filenames, findings)
+        _check_truncated(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)

@@ -5,7 +5,7 @@ Drives paddle_tpu.serving.ServingEngine over a DecoderLM with synthetic
 Poisson traffic — mixed prompt lengths, open-loop arrivals — and prints
 ONE JSON line in the bench.py artifact schema.
 
-Three modes (`--scheduler`):
+Five modes (`--scheduler`):
 
   fifo   the PR 7 baseline engine (worst-case page reservation, strict
          FIFO, whole-prompt prefill) — the original artifact, unchanged;
@@ -17,6 +17,27 @@ Three modes (`--scheduler`):
          comparison artifact the evidence daemon queues as `serve_v2`.
          Headline = v2 standard-workload tokens/s; `vs_baseline` = its
          gain over fifo at the SAME load and pool.
+  spec   the ISSUE 18 speculative engine vs the v2 autoregressive
+         baseline at the SAME Poisson load and model weights, paired
+         runs, median-of-SERVE_REPEATS per side: the draft (the
+         target's own first SERVE_SPEC_DRAFT_LAYERS blocks) proposes
+         K tokens per round and one chunked-prefill run verifies all
+         K+1 positions.  Headline = spec tokens/s, `vs_baseline` = its
+         gain over v2, `outputs_match` = exact greedy token identity on
+         EVERY completed request of EVERY repeat, and the measured
+         accept rate rides in `accept_rate` — published honestly, it
+         is the entire story of the speedup.  The synthetic model's
+         tail layers are damped (see damp_tail_layers) so its greedy
+         stream is draft-predictable like a real LM's; set
+         SERVE_SPEC_TAIL_SCALE=0 for the raw max-entropy model (spec
+         then loses, accept ~ 1/vocab — that row is honest too).
+  router the ISSUE 18 scale-out row: ONE pool-starved wide engine vs a
+         ReplicaRouter over SERVE_REPLICAS right-sized replicas (same
+         per-device page pool, same total offered load), paired runs,
+         median-of-SERVE_REPEATS.  Headline = router aggregate
+         tokens/s, `vs_baseline` = its gain over the single replica;
+         the preemption/re-prefill waste and placement split that
+         explain the gain are in the comparison rows.
 
 In ab/v2 modes (or with SERVE_POOL_FRAC set explicitly) both engines run
 against the same deliberately undersized page pool (SERVE_POOL_FRAC x
@@ -39,6 +60,15 @@ Env knobs (bench.py idiom):
   SERVE_SWEEP           extra slot counts to also run (fifo/v2 modes
                         only), e.g. "1,8"
   PADDLE_TPU_PAGE_SIZE  KV page size (serving/kv_cache.py)
+  SERVE_REPEATS=3       paired repeats per side (spec/router modes);
+                        medians are compared, not single runs
+  SERVE_SPEC_K=6        speculation depth (spec mode; exported as
+                        PADDLE_TPU_SPEC_K so the knob layer resolves it
+                        above any persisted autotune winner)
+  SERVE_SPEC_DRAFT_LAYERS=1      draft tower depth (spec mode)
+  SERVE_SPEC_TAIL_SCALE=0.01     damping of the target's post-draft
+                        residual branches (spec mode; 0 disables)
+  SERVE_REPLICAS=2      replica count (router mode)
 
 Flags:
   --scheduler {fifo,v2,ab}   default fifo
@@ -103,7 +133,44 @@ def pool_pages(slots, cfg):
                    int(round(cfg["pool_frac"] * worst_all)))
 
 
-def build_engine(slots, cfg, scheduler="fifo", seed=0):
+def damp_tail_layers(cfg):
+    """Scale down the residual-branch OUTPUT projections (attention out,
+    MLP down) of every layer past the draft depth, in the global scope,
+    after startup ran.
+
+    Why: a random-init model's greedy stream is maximum-entropy — the
+    draft's agreement with the target is ~1/vocab, the adversarial
+    worst case for speculative decoding, while real LM decode streams
+    are low-entropy and draft-predictable (that predictability is the
+    entire premise of the technique).  Damping the post-draft branches
+    makes those layers near-identity refinements of the shared trunk,
+    giving the synthetic model a realistic accept rate — which the
+    artifact publishes, so the row never pretends the speedup is free.
+    Both engines of the A/B get the SAME damped weights (token identity
+    is checked across them).  The scale stays >= ~1e-2: far above the
+    float32 subnormal range, because XLA:CPU arithmetic on denormals is
+    10-50x slower and would corrupt the measurement."""
+    import paddle_tpu as fluid
+
+    scale = cfg.get("spec_tail_scale") or 0.0
+    if not scale:
+        return
+    sc = fluid.global_scope()
+    for l in range(cfg["spec_draft"], cfg["layers"]):
+        # DecoderLM builds 6 fc's per block in order q,k,v,out,up,down:
+        # indices 6l+3 (attn out) and 6l+5 (mlp down) are the branch
+        # outputs feeding the residual stream
+        for idx in (6 * l + 3, 6 * l + 5):
+            name = f"fc_{idx}.w_0"
+            w = sc.find_np(name)
+            assert w is not None, f"damp_tail_layers: no var {name}"
+            sc.set(name, (w * scale).astype(w.dtype))
+
+
+def build_engine(slots, cfg, scheduler="fifo", seed=0, pool_slots=None):
+    """`pool_slots` sizes the page pool for a DIFFERENT slot count than
+    the engine's own (router mode: every device carries the same pool,
+    so a right-sized 8-slot replica gets the 16-slot device's pages)."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
     from paddle_tpu.serving import ServingEngine
@@ -117,11 +184,14 @@ def build_engine(slots, cfg, scheduler="fifo", seed=0):
     fluid.default_main_program().random_seed = seed
     exe = fluid.Executor(fluid.default_place())
     exe.run(fluid.default_startup_program())
+    if "spec_tail_scale" in cfg:
+        damp_tail_layers(cfg)
     kw = {}
-    if scheduler == "v2":
+    if scheduler in ("v2", "spec"):
         kw["chunk_size"] = min(cfg["chunk"], cfg["max_len"])
     return lm, ServingEngine(lm, max_batch_size=slots,
-                             num_pages=pool_pages(slots, cfg),
+                             num_pages=pool_pages(pool_slots or slots,
+                                                  cfg),
                              scheduler=scheduler,
                              place=fluid.default_place(), **kw)
 
@@ -213,6 +283,18 @@ def _warm(engine, spec, scheduler):
                 engine.submit(prompt, 2)
         engine.run()
     else:
+        if scheduler == "spec":
+            # the fused K-step draft program only runs once a request
+            # reaches a steady decode round with remaining budget >= 2
+            # (the COW warm's max_new=2 request emits its last token in
+            # a verify-only round and never drafts), so its one-time
+            # XLA compile — seconds, dwarfing the measured window —
+            # must be triggered explicitly here
+            k = engine._spec.k
+            warm_rng = np.random.RandomState(4242)
+            engine.submit(warm_rng.randint(
+                0, engine.lm.vocab_size, size=4).tolist(), k + 4)
+            engine.run()
         rng = np.random.RandomState(12345)
         # EXACTLY two whole pages: the identical resubmit then shares
         # block 0 and copy-on-writes block 1 (reuse cap = len-1 leaves
@@ -285,6 +367,14 @@ def measure(slots, cfg, scheduler="fifo", workload="standard", seed=0):
         "preemptions": st["preemptions"],
         "cow_copies": st["cow_copies"],
     }
+    if scheduler == "spec":
+        cnt = engine.counters
+        row["spec_rounds"] = cnt["spec_rounds"]
+        row["spec_drafted"] = cnt["spec_drafted"]
+        row["spec_accepted"] = cnt["spec_accepted"]
+        row["spec_emitted"] = cnt["spec_emitted"]
+        row["accept_rate"] = round(
+            cnt["spec_accepted"] / max(cnt["spec_drafted"], 1), 4)
     # generated streams by SUBMISSION order: the cross-scheduler
     # token-identity check keys on this, not on engine-global rids
     outputs = [finished[rid].generated if rid in finished else None
@@ -462,6 +552,152 @@ def _single_artifact(cfg, rows, scheduler):
         extra_metrics=extra)
 
 
+def _median_row(rows):
+    """(representative row, median tok/s): the row closest to the median
+    — exact for odd repeat counts — so published percentiles/counters
+    come from one real run, never an average of incomparable runs."""
+    import statistics
+
+    med = statistics.median(r["tok_per_s"] for r in rows)
+    return min(rows, key=lambda r: abs(r["tok_per_s"] - med)), med
+
+
+def _spec_artifact(cfg, slots, runs, matches):
+    """runs["spec"]/runs["v2"] = per-repeat measure() rows (paired, same
+    load); matches[i] = repeat i's exact greedy token identity."""
+    from paddle_tpu.observability import artifact_metric
+
+    sp, med_sp = _median_row(runs["spec"])
+    v2, med_v2 = _median_row(runs["v2"])
+    gain = med_sp / max(med_v2, 1e-9) - 1.0
+    extra = [
+        artifact_metric(f"serve_spec_accept_rate_bs{slots}",
+                        sp["accept_rate"], "frac"),
+        artifact_metric(f"serve_spec_baseline_v2_tok_per_s_bs{slots}",
+                        round(med_v2, 1), "tokens/sec",
+                        percentiles={"p50_ms": v2["lat_p50_ms"],
+                                     "p99_ms": v2["lat_p99_ms"]}),
+    ]
+    return artifact_metric(
+        f"serve_spec_decode_tok_per_s_bs{slots}",
+        round(med_sp, 1), "tokens/sec",
+        vs_baseline=round(gain, 4),
+        note=(f"speculative vs autoregressive v2 at identical Poisson "
+              f"load (rate {cfg['rate']}/s, {cfg['requests']} reqs, "
+              f"median of {len(matches)} paired runs): spec "
+              f"{med_sp:.0f} tok/s (K={cfg['spec_k']}, draft "
+              f"{cfg['spec_draft']}/{cfg['layers']} layers, accept "
+              f"rate {sp['accept_rate']:.0%}) vs v2 {med_v2:.0f} "
+              f"tok/s, outputs exactly token-identical on every "
+              f"completed request of every repeat; tail damping "
+              f"{cfg.get('spec_tail_scale', 0)} makes the synthetic "
+              f"greedy stream draft-predictable (real-LM regime; the "
+              f"speedup is the accept rate, nothing else); baseline = "
+              f"the v2 row of this artifact"),
+        percentiles={"p50_ms": sp["lat_p50_ms"],
+                     "p99_ms": sp["lat_p99_ms"],
+                     "ttft_p50_ms": sp["ttft_p50_ms"],
+                     "ttft_p99_ms": sp["ttft_p99_ms"]},
+        outputs_match=all(matches),
+        outputs_match_by_repeat=list(matches),
+        accept_rate=sp["accept_rate"],
+        comparison={"spec": sp, "v2": v2},
+        extra_metrics=extra)
+
+
+def _router_trial(cfg, slots, n_replicas):
+    """One paired run: the single pool-starved wide engine, then a
+    ReplicaRouter over right-sized replicas — same model seed, same
+    per-device page pool, same request spec.  Returns the single row,
+    the router row, and both output streams (submission order)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ReplicaRouter
+
+    spec = synth_requests(cfg["requests"], cfg["rate"], cfg["pmin"],
+                          cfg["pmax"], cfg["max_new"], cfg["vocab"],
+                          seed=0)
+    single, srow, souts = measure(slots, cfg, scheduler="v2")
+    _leak_check(single)
+
+    rslots = max(1, slots // n_replicas)
+    engines = []
+    for _ in range(n_replicas):
+        fluid.reset()
+        _, e = build_engine(rslots, cfg, scheduler="v2",
+                            pool_slots=slots)
+        _warm(e, spec, "v2")
+        engines.append(e)
+    router = ReplicaRouter(engines)
+    rids, elapsed = run_load(router, spec)
+    fin = {}
+    for e in engines:
+        fin.update(e.finished)
+    toks = sum(len(r.generated) for r in fin.values())
+    lat = [r.finish_t - r.arrival for r in fin.values()]
+    rrow = {
+        "scheduler": "router",
+        "replicas": n_replicas,
+        "slots": rslots,
+        "requests": len(fin),
+        "tokens": toks,
+        "tok_per_s": round(toks / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+        "lat_p50_ms": percentile_ms(lat, 50),
+        "lat_p99_ms": percentile_ms(lat, 99),
+        "num_pages": engines[0].num_pages,
+        "placements": list(router.placements),
+        "step_cost_s": [round(s, 9) for s in router.step_cost_s],
+        "preemptions": sum(e.stats()["preemptions"] for e in engines),
+        "prefill_tokens_computed": sum(
+            e.stats()["prefill_computed"] for e in engines),
+    }
+    routs = [fin[rid].generated if rid in fin else None for rid in rids]
+    # no cross-shape token-identity claim here: the batch-{slots} and
+    # batch-{rslots} executables reduce in different orders, and greedy
+    # near-ties under random weights legitimately flip — the identity
+    # contract belongs to the spec row (same engine shape both sides)
+    return engines, srow, souts, rrow, routs
+
+
+def _router_artifact(cfg, slots, srows, rrows):
+    from paddle_tpu.observability import artifact_metric
+
+    sr, med_s = _median_row(srows)
+    rr, med_r = _median_row(rrows)
+    gain = med_r / max(med_s, 1e-9) - 1.0
+    n, rslots = rr["replicas"], rr["slots"]
+    extra = [
+        artifact_metric(f"serve_router_single_tok_per_s_bs{slots}",
+                        round(med_s, 1), "tokens/sec",
+                        percentiles={"p50_ms": sr["lat_p50_ms"],
+                                     "p99_ms": sr["lat_p99_ms"]}),
+    ]
+    return artifact_metric(
+        f"serve_router_tok_per_s_r{n}_bs{rslots}",
+        round(med_r, 1), "tokens/sec",
+        vs_baseline=round(gain, 4),
+        note=(f"scale-out at identical Poisson load (rate "
+              f"{cfg['rate']}/s, {cfg['requests']} reqs, median of "
+              f"{len(rrows)} paired runs, per-device pool "
+              f"{rr['num_pages']} pages): {n}x{rslots}-slot replicas "
+              f"{med_r:.0f} tok/s (placements {rr['placements']}, "
+              f"{rr['preemptions']} preempts re-prefilling "
+              f"{rr['prefill_tokens_computed']} tokens) vs one "
+              f"{slots}-slot engine {med_s:.0f} tok/s "
+              f"({sr['preemptions']} preempts, "
+              f"{sr['prefill_tokens_computed']} prefill tokens): the "
+              f"wide engine is pool-starved — every step pays the "
+              f"{slots}-wide program for pool-limited active lanes "
+              f"and its growth preemptions re-prefill full contexts; "
+              f"placement by analyzer-predicted finish "
+              f"(step_cost_s {rr['step_cost_s']}); baseline = the "
+              f"single-replica row of this artifact"),
+        percentiles={"p50_ms": rr["lat_p50_ms"],
+                     "p99_ms": rr["lat_p99_ms"]},
+        comparison={"single": sr, "router": rr},
+        extra_metrics=extra)
+
+
 def main(argv=None):
     import warnings
 
@@ -472,7 +708,8 @@ def main(argv=None):
     warnings.filterwarnings(
         "ignore", message=".*requested in astype is not available.*")
     ap = argparse.ArgumentParser()
-    ap.add_argument("--scheduler", choices=["fifo", "v2", "ab"],
+    ap.add_argument("--scheduler",
+                    choices=["fifo", "v2", "ab", "spec", "router"],
                     default="fifo")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--save-programs", metavar="DIR")
@@ -489,23 +726,51 @@ def main(argv=None):
     if args.trace:
         obs.enable_tracing()
 
+    # per-mode defaults: spec wants a decode-heavy mix on a deep model
+    # (short prompts, long generation — where draft cost amortizes) at
+    # a full pool; router wants a preemption-prone mix on a wide engine
+    # at a per-device pool the wide engine starves against.  Both were
+    # picked empirically on the CPU harness for a stable structural
+    # differential, and both run paired + median-of-SERVE_REPEATS.
+    if args.scheduler == "spec":
+        defaults = dict(dim=512, layers=4, heads=8, vocab=128,
+                        requests=32, rate=300.0, pmin=4, pmax=8,
+                        max_new=56, pool_frac=1.0, chunk=16, slots=4)
+    elif args.scheduler == "router":
+        defaults = dict(dim=512, layers=2, heads=8, vocab=128,
+                        requests=32, rate=500.0, pmin=4, pmax=8,
+                        max_new=56, pool_frac=0.32, chunk=16, slots=16)
+    else:
+        defaults = dict(dim=128, layers=2, heads=4, vocab=512,
+                        requests=96, rate=32.0, pmin=8, pmax=96,
+                        max_new=32, pool_frac=0.55, chunk=32, slots=64)
+
     if args.smoke:
         cfg = dict(dim=32, layers=2, heads=2, vocab=64, max_len=128,
                    requests=8, rate=200.0, pmin=3, pmax=24, max_new=6,
                    pool_frac=0.75, chunk=8)
         slot_list = [4]
+        if args.scheduler == "spec":
+            # long enough generation for real multi-token windows
+            cfg.update(pmax=8, max_new=10, pool_frac=1.0,
+                       max_len=128)
+        elif args.scheduler == "router":
+            cfg.update(pmax=8, max_new=8)
     else:
-        cfg = dict(dim=_env_int("SERVE_DIM", 128),
-                   layers=_env_int("SERVE_LAYERS", 2),
-                   heads=_env_int("SERVE_HEADS", 4),
-                   vocab=_env_int("SERVE_VOCAB", 512),
-                   requests=_env_int("SERVE_REQUESTS", 96),
-                   rate=_env_float("SERVE_RATE", 32.0),
-                   pmin=_env_int("SERVE_PROMPT_MIN", 8),
-                   pmax=_env_int("SERVE_PROMPT_MAX", 96),
-                   max_new=_env_int("SERVE_MAX_NEW", 32),
-                   pool_frac=_env_float("SERVE_POOL_FRAC", 0.55),
-                   chunk=_env_int("SERVE_CHUNK", 32))
+        cfg = dict(dim=_env_int("SERVE_DIM", defaults["dim"]),
+                   layers=_env_int("SERVE_LAYERS", defaults["layers"]),
+                   heads=_env_int("SERVE_HEADS", defaults["heads"]),
+                   vocab=_env_int("SERVE_VOCAB", defaults["vocab"]),
+                   requests=_env_int("SERVE_REQUESTS",
+                                     defaults["requests"]),
+                   rate=_env_float("SERVE_RATE", defaults["rate"]),
+                   pmin=_env_int("SERVE_PROMPT_MIN", defaults["pmin"]),
+                   pmax=_env_int("SERVE_PROMPT_MAX", defaults["pmax"]),
+                   max_new=_env_int("SERVE_MAX_NEW",
+                                    defaults["max_new"]),
+                   pool_frac=_env_float("SERVE_POOL_FRAC",
+                                        defaults["pool_frac"]),
+                   chunk=_env_int("SERVE_CHUNK", defaults["chunk"]))
         cfg["max_len"] = cfg["pmax"] + cfg["max_new"]
         if args.scheduler == "fifo" and "SERVE_POOL_FRAC" not in os.environ:
             # the PR 7 longitudinal capture: standalone fifo keeps the
@@ -514,10 +779,25 @@ def main(argv=None):
             # SERVE_POOL_FRAC) run the constrained pool where admission
             # policy actually matters
             cfg["pool_frac"] = None
-        slot_list = [_env_int("SERVE_SLOTS", 64)]
-        if args.scheduler != "ab":
+        slot_list = [_env_int("SERVE_SLOTS", defaults["slots"])]
+        if args.scheduler in ("fifo", "v2"):
             sweep = os.environ.get("SERVE_SWEEP", "")
             slot_list += [int(s) for s in sweep.split(",") if s.strip()]
+
+    cfg["repeats"] = 1 if args.smoke else _env_int("SERVE_REPEATS", 3)
+    if args.scheduler == "spec":
+        cfg["spec_k"] = _env_int("SERVE_SPEC_K", 4 if args.smoke else 6)
+        cfg["spec_draft"] = _env_int("SERVE_SPEC_DRAFT_LAYERS", 1)
+        cfg["spec_tail_scale"] = _env_float("SERVE_SPEC_TAIL_SCALE",
+                                            0.01)
+        # export through the knob env (validated there) so the bench
+        # config outranks any persisted `paddle tune spec_decode`
+        # winner — the A/B row must be self-describing
+        os.environ["PADDLE_TPU_SPEC_K"] = str(cfg["spec_k"])
+        os.environ["PADDLE_TPU_SPEC_DRAFT_LAYERS"] = str(
+            cfg["spec_draft"])
+    elif args.scheduler == "router":
+        cfg["replicas"] = max(2, _env_int("SERVE_REPLICAS", 2))
 
     engine = None
     # fluid.reset() inside measure() wipes the registry/tracer between
@@ -567,6 +847,56 @@ def main(argv=None):
             assert results[("prefix", "v2")]["prefill_cache_frac"] >= 0.3, \
                 results[("prefix", "v2")]
         artifact = _ab_artifact(cfg, slots, results, matches)
+    elif args.scheduler == "spec":
+        slots = slot_list[0]
+        spec_runs = {"v2": [], "spec": []}
+        spec_matches = []
+        for rep in range(cfg["repeats"]):
+            outs = {}
+            for sched in ("v2", "spec"):
+                engine, row, outputs = measure(slots, cfg,
+                                               scheduler=sched)
+                _harvest("standard", sched)
+                spec_runs[sched].append(row)
+                outs[sched] = outputs
+                if args.smoke:
+                    assert row["requests"] == cfg["requests"], row
+                    _leak_check(engine)
+                if args.save_programs:
+                    save_programs(engine, args.save_programs,
+                                  prefix="" if sched == "spec"
+                                  else "ar_")
+            # the acceptance contract, per repeat: exact greedy token
+            # identity on every completed request, spec vs v2
+            ok = all(a is not None and a == b
+                     for a, b in zip(outs["v2"], outs["spec"]))
+            spec_matches.append(ok)
+            if args.smoke:
+                assert ok, "spec tokens diverge from autoregressive v2"
+        if args.smoke:
+            r = spec_runs["spec"][0]
+            assert r["spec_rounds"] > 0 and r["spec_emitted"] > 0, r
+            assert r["spec_drafted"] > 0, r
+        artifact = _spec_artifact(cfg, slots, spec_runs, spec_matches)
+    elif args.scheduler == "router":
+        slots = slot_list[0]
+        srows, rrows = [], []
+        for rep in range(cfg["repeats"]):
+            engines, srow, souts, rrow, routs = _router_trial(
+                cfg, slots, cfg["replicas"])
+            _harvest("standard", "router")
+            srows.append(srow)
+            rrows.append(rrow)
+            if args.smoke:
+                assert rrow["requests"] == cfg["requests"], rrow
+                assert all(r is not None and
+                           1 <= len(r) <= cfg["max_new"]
+                           for r in routs), "router dropped a request"
+                assert all(p > 0 for p in rrow["placements"]), \
+                    f"replica starved: {rrow['placements']}"
+                for e in engines:
+                    _leak_check(e)
+        artifact = _router_artifact(cfg, slots, srows, rrows)
     else:
         rows = []
         for slots in slot_list:
@@ -588,8 +918,16 @@ def main(argv=None):
     # the measured mean step time of this very run
     if args.scheduler == "ab":
         head = results[("standard", "fifo")]
+        density_rows = list(results.values())
+    elif args.scheduler == "spec":
+        head = spec_runs["v2"][0]
+        density_rows = spec_runs["v2"] + spec_runs["spec"]
+    elif args.scheduler == "router":
+        head = srows[0]
+        density_rows = srows
     else:
         head = rows[0]
+        density_rows = rows
     mean_step_s = head["elapsed_raw_s"] / max(head["steps"], 1)
     span_hooks = None
     if args.trace and trace_windows:
@@ -598,9 +936,7 @@ def main(argv=None):
         # added: total complete events / total engine steps, rounded up
         total_spans = sum(1 for w in trace_windows for e in w
                           if e.get("ph") == "X")
-        all_rows = (list(results.values()) if args.scheduler == "ab"
-                    else rows)
-        total_steps = sum(r["steps"] for r in all_rows)
+        total_steps = sum(r["steps"] for r in density_rows)
         span_hooks = -(-total_spans // max(total_steps, 1))
     overhead = telemetry_overhead_frac(mean_step_s,
                                        span_hooks=span_hooks)
