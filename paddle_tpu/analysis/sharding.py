@@ -74,27 +74,61 @@ class AxisNames(tuple):
         return "AxisNames%s" % tuple.__repr__(self)
 
 
-# ordered (logical axis, mesh axis | None) pairs; earlier rules win,
-# later duplicates are fallbacks tried when the winner's mesh axis is
-# unavailable or does not divide the dim
-LogicalAxisRules = Sequence[Tuple[str, Optional[str]]]
+# ordered (logical axis, mesh axis | None[, min dim size]) entries;
+# earlier rules win, later duplicates are fallbacks tried when the
+# winner's mesh axis is unavailable or does not divide the dim.  The
+# optional third element is a width threshold: the rule only applies to
+# dims of at least that size (the ≥128 column-parallel gate — sharding
+# a narrow fc over mp costs more in lane padding than it saves).  A
+# mesh-axis entry may itself be a TUPLE of axis names (hybrid ICI×DCN
+# meshes: ("dcn_dp", "dp") shards one dim over both link classes).
+LogicalAxisRules = Sequence[tuple]
+
+# the ≥128 column-parallel width threshold (rule family 3 of the
+# partitioner collapse): last-dim mp sharding only pays for itself at
+# lane width — one constant shared by the rule table, its tests, and
+# the docs table
+COLUMN_PARALLEL_MIN = 128
 
 
 def standard_logical_axis_rules(dp_axis: str = "dp", mp_axis: str = "mp",
-                                sp_axis: str = "sp") -> list:
+                                sp_axis: str = "sp",
+                                zero_dp_states: bool = False,
+                                fsdp_params: bool = False) -> list:
     """The default logical→mesh table: the rules the 11 bespoke modes
-    collapse into (ROADMAP #2).  `None` pins a logical axis replicated."""
-    return [
+    collapse into (ROADMAP #2).  `None` pins a logical axis replicated.
+
+    `state0` names dim 0 of an optimizer accumulator and `param0` dim 0
+    of a non-embedding trainable param — replicated by default.  Rule
+    family 1 (ZeRO-1 / FSDP dim-0 optimizer-state reshard, the
+    cross-replica weight-update sharding of arXiv:2004.13336) is two
+    flags inserting dp-axis rules for those names: `zero_dp_states`
+    shards accumulator dim 0, `fsdp_params` additionally shards
+    trainable-param dim 0 (with a `("vocab", dp)` FALLBACK so an
+    embedding table dp-shards only where no mp axis claimed it).
+    Indivisible dims fall through to the replicated fallbacks — the
+    same `shape[0] % dp == 0` gate the bespoke wiring applied."""
+    rules: list = [
         ("batch", dp_axis),
         ("length", sp_axis),
         ("vocab", mp_axis),
-        ("mlp", mp_axis),
+        ("mlp", mp_axis, COLUMN_PARALLEL_MIN),
         ("heads", mp_axis),
         ("expert", "ep"),
         ("stage", "pp"),
+    ]
+    if fsdp_params:
+        rules += [("vocab", dp_axis), ("param0", dp_axis),
+                  ("state0", dp_axis)]
+    elif zero_dp_states:
+        rules += [("state0", dp_axis)]
+    rules += [
         ("embed", None),
         ("kv", None),
+        ("state0", None),
+        ("param0", None),
     ]
+    return rules
 
 
 def logical_to_mesh_axes(axis_names: Sequence[Optional[str]],
@@ -106,24 +140,41 @@ def logical_to_mesh_axes(axis_names: Sequence[Optional[str]],
 
     For each dim: the first rule matching its logical name whose mesh
     axis exists (size > 1) and divides the dim wins; no match (or an
-    explicit `(logical, None)` rule) leaves the dim unsharded.  A mesh
-    axis already claimed by an earlier dim of the SAME variable is a
-    conflict (two rules forcing incompatible specs on one var — a tensor
-    cannot shard two dims over one axis); the later dim stays unsharded
-    and the conflict is recorded for PTV018."""
+    explicit `(logical, None)` rule) leaves the dim unsharded.  A rule
+    may carry a third element — a minimum dim size below which it is
+    skipped (the ≥128 column-parallel width gate), falling through to
+    the next rule like an absent axis.  A mesh-axis entry may be a
+    TUPLE of axis names (hybrid ICI×DCN meshes): the dim shards over
+    their product, all components must exist and the product must
+    divide the dim.  A mesh axis already claimed by an earlier dim of
+    the SAME variable is a conflict (two rules forcing incompatible
+    specs on one var — a tensor cannot shard two dims over one axis);
+    the later dim stays unsharded and the conflict is recorded for
+    PTV018."""
     spec: List[Optional[str]] = []
     used: Dict[str, str] = {}
     for d, logical in enumerate(axis_names):
         chosen = None
         if logical is not None:
-            for rule_logical, mesh_axis in rules:
+            for rule in rules:
+                rule_logical, mesh_axis = rule[0], rule[1]
+                min_size = int(rule[2]) if len(rule) > 2 else 0
                 if rule_logical != logical:
                     continue
+                if min_size and dim_sizes is not None \
+                        and d < len(dim_sizes) \
+                        and 0 <= int(dim_sizes[d]) < min_size:
+                    continue  # below the width gate: try a fallback
                 if mesh_axis is None:
                     break  # explicitly replicated
+                parts = entry_axes(mesh_axis)
                 if mesh_axis_sizes is not None:
-                    size = int(mesh_axis_sizes.get(mesh_axis, 1))
-                    if size <= 1:
+                    size = 1
+                    for a in parts:
+                        size *= int(mesh_axis_sizes.get(a, 1))
+                    if size <= 1 or any(
+                            int(mesh_axis_sizes.get(a, 1)) <= 1
+                            for a in parts):
                         continue  # axis absent: try a fallback rule
                     if dim_sizes is not None and d < len(dim_sizes) \
                             and int(dim_sizes[d]) >= 0 \
@@ -131,13 +182,14 @@ def logical_to_mesh_axes(axis_names: Sequence[Optional[str]],
                         continue  # indivisible: try a fallback rule
                         # (-1 batch markers are feed-time dims the
                         # caller promises to keep divisible)
-                if mesh_axis in used:
+                clash = next((a for a in parts if a in used), None)
+                if clash is not None:
                     if conflicts is not None:
-                        conflicts.append(
-                            (logical, mesh_axis, used[mesh_axis]))
+                        conflicts.append((logical, clash, used[clash]))
                     break
                 chosen = mesh_axis
-                used[mesh_axis] = logical
+                for a in parts:
+                    used[a] = logical
                 break
         spec.append(chosen)
     return tuple(spec)
@@ -179,13 +231,33 @@ class LogicalPartitioner:
             return AxisNames("batch", *([None] * (ndim - 1)))
         if var.name in embedding_names and ndim >= 2:
             return AxisNames("vocab", *(["embed"] * (ndim - 1)))
+        if getattr(var, "accumulator_for", None):
+            # optimizer accumulator (positively tagged by
+            # Optimizer._add_accumulator): dim 0 is the ZeRO-1 shard
+            # target — replicated under the standard table, dp-sharded
+            # when `zero_dp_states`/`fsdp_params` insert a state0 rule
+            if ndim == 0:
+                return AxisNames()
+            tail = ["mlp"] if ndim == 2 else [None] * (ndim - 1)
+            return AxisNames("state0", *tail)
+        trainable = getattr(var, "trainable", False)
         if ndim == 2:
-            return AxisNames("embed", "mlp")
+            return AxisNames("param0" if trainable else "embed", "mlp")
+        if trainable and ndim >= 1:
+            # conv filters, biases, BN scale/shift: dim 0 is the FSDP
+            # shard target (param0 → dp only when an fsdp rule exists)
+            return AxisNames("param0", *([None] * (ndim - 1)))
         return AxisNames(*([None] * ndim))
 
-    def plan(self, program, mesh) -> Dict[str, object]:
+    def plan(self, program, mesh,
+             provenance: Optional[Dict[str, str]] = None
+             ) -> Dict[str, object]:
         """{var: NamedSharding} over `mesh` for every persistable and
-        feed var; records conflicts (never raises on them)."""
+        feed var; records conflicts (never raises on them).  Pass
+        `provenance={}` to collect {var: which rule produced the spec}
+        — the strings `ParallelExecutor.static_plan` forwards into
+        PTV016 findings (kept in the shapes the pre-collapse bespoke
+        wiring minted, so existing triage docs stay accurate)."""
         from ..parallel.mesh import mesh_axis_sizes, named
 
         sizes = mesh_axis_sizes(mesh)
@@ -222,7 +294,40 @@ class LogicalPartitioner:
                                   f"{tuple(spec)!r} on {var.name!r}"})
                 spec = tuple(want)
             out[var.name] = named(mesh, *spec)
+            if provenance is not None and any(e for e in spec):
+                provenance[var.name] = describe_rule(var, names, spec,
+                                                     sizes)
         return out
+
+
+def describe_rule(var, names: AxisNames, spec: tuple,
+                  axis_sizes: Dict[str, int]) -> str:
+    """Human name of the logical rule that produced `spec` for `var`."""
+    def prod(entry) -> int:
+        n = 1
+        for a in entry_axes(entry):
+            n *= int(axis_sizes.get(a, 1))
+        return n
+
+    if getattr(var, "is_data", False):
+        parts = []
+        if spec and spec[0] is not None:
+            parts.append(f"feed batch rule ({spec[0]!r} on dim 0)")
+        if len(spec) > 1 and spec[1] is not None:
+            parts.append(f"length rule ({spec[1]!r} on dim 1)")
+        return " + ".join(parts) or "feed rule"
+    lead = names[0] if names else None
+    if spec and spec[0] is not None:
+        if lead == "state0":
+            return (f"ZeRO-1 accumulator reshard over {spec[0]!r} on "
+                    f"dim 0 (axis size {prod(spec[0])})")
+        if lead == "param0":
+            return (f"FSDP/ZeRO-3 parameter shard over {spec[0]!r} on "
+                    f"dim 0 (axis size {prod(spec[0])})")
+        return f"vocab/dim-0 shard rule ({spec[0]!r} on dim 0)"
+    if spec and spec[-1] is not None:
+        return f"column-parallel rule ({spec[-1]!r} on the last dim)"
+    return "axis rule"
 
 
 # ---------------------------------------------------------------------------
@@ -1135,9 +1240,15 @@ def wire_factor(kind: str, n: int) -> float:
 def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
                 dcn: Optional[Iterable[str]] = None) -> dict:
     """Price the implied collectives over the chip's ICI and DCN links:
-    per-kind/per-axis byte totals, wire bytes, and the predicted
-    communication time that joins the roofline
-    (`cost.roofline_with_comm`)."""
+    per-kind/per-axis byte totals, wire bytes per LINK CLASS
+    (``link_bytes``), and the predicted communication time that joins
+    the roofline (`cost.roofline_with_comm`).
+
+    A collective spanning BOTH link classes (a hybrid multi-slice mesh
+    sharding one dim over ``("dcn_dp", "dp")``) is priced as GSPMD's
+    hierarchical all-reduce decomposition: per-slice ICI reduce-scatter
+    → DCN all-reduce of the 1/n_ici shard → per-slice ICI all-gather,
+    so the slow DCN link carries only 1/n_ici of the buffer."""
     from .cost import chip_spec
 
     spec = chip_spec(chip)
@@ -1149,18 +1260,43 @@ def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
     per_kind: Dict[str, dict] = {}
     per_axis: Dict[str, dict] = {}
     t_ici = t_dcn = 0.0
+    link_bytes = {"ici": 0, "dcn": 0}
     breakdown = []
     for c in analysis.collectives:
-        n = 1
+        sizes = analysis.axis_sizes
+        n_ici = n_dcn = 1
         for a in c.axes:
-            n *= int(analysis.axis_sizes.get(a, 1))
-        wire = wire_factor(c.kind, n) * c.bytes
-        crosses_dcn = any(a in dcn for a in c.axes)
-        t = wire / (dcn_bw if crosses_dcn else ici_bw)
-        if crosses_dcn:
-            t_dcn += t
+            if a in dcn:
+                n_dcn *= int(sizes.get(a, 1))
+            else:
+                n_ici *= int(sizes.get(a, 1))
+        n = n_ici * n_dcn
+        decomposed = None
+        if n_dcn > 1 and n_ici > 1 and c.kind == "all-reduce":
+            # hierarchical hybrid all-reduce: ICI RS + AG move the same
+            # wire bytes as a flat ICI all-reduce of the buffer; the
+            # DCN all-reduce runs on the reduce-scattered 1/n_ici shard
+            w_ici = wire_factor("all-reduce", n_ici) * c.bytes
+            w_dcn = wire_factor("all-reduce", n_dcn) * (c.bytes // n_ici)
+            decomposed = {
+                "ici_reduce_scatter_bytes": int(
+                    wire_factor("reduce-scatter", n_ici)
+                    * (c.bytes // n_ici)),
+                "dcn_all_reduce_bytes": int(w_dcn),
+                "ici_all_gather_bytes": int(
+                    wire_factor("all-gather", n_ici) * c.bytes),
+            }
+        elif n_dcn > 1:
+            w_ici = 0.0
+            w_dcn = wire_factor(c.kind, n) * c.bytes
         else:
-            t_ici += t
+            w_ici = wire_factor(c.kind, n) * c.bytes
+            w_dcn = 0.0
+        wire = w_ici + w_dcn
+        t_ici += w_ici / ici_bw
+        t_dcn += w_dcn / dcn_bw
+        link_bytes["ici"] += int(w_ici)
+        link_bytes["dcn"] += int(w_dcn)
         e = per_kind.setdefault(c.kind, {"count": 0, "bytes": 0,
                                          "wire_bytes": 0})
         e["count"] += 1
@@ -1171,9 +1307,12 @@ def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
                                          "dcn": a in dcn})
             ax["count"] += 1
             ax["bytes"] += c.bytes
-        breakdown.append({
+        entry = {
             "kind": c.kind, "axes": list(c.axes), "bytes": c.bytes,
-            "phase": c.phase, "var": c.var, "why": c.why})
+            "phase": c.phase, "var": c.var, "why": c.why}
+        if decomposed is not None:
+            entry["decomposed"] = decomposed
+        breakdown.append(entry)
     return {
         "chip": spec["chip"],
         "collective_count": len(analysis.collectives),
@@ -1184,6 +1323,7 @@ def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
         "ici_time_s": t_ici,
         "dcn_time_s": t_dcn,
         "dcn_axes": sorted(dcn),
+        "link_bytes": link_bytes,
         "breakdown": breakdown,
     }
 

@@ -113,6 +113,20 @@ def price(workload, candidate, chip: Optional[str] = None,
                                          == "float32" else 1.0)
             t_compute = c["flops"] / rate
             t_memory = c["bytes"] / (spec["hbm_gbps"] * 1e9)
+            comm_fn = getattr(workload, "comm_cost", None)
+            if comm_fn is not None:
+                # mesh-layout-style workloads: compute/memory tie across
+                # candidates, the per-link-class wire time is the ranking
+                # signal — fold it through the comm-aware roofline
+                folded = _cost.roofline_with_comm(
+                    {"compute_time_s": t_compute,
+                     "memory_time_s": t_memory},
+                    comm_fn(candidate, spec),
+                    devices=int(c.get("devices", 1)))
+                return PricedCandidate(
+                    candidate, folded["predicted_step_time_s"],
+                    int(c.get("peak_bytes", c["bytes"])),
+                    bound=folded["predicted_bound"])
             step = max(t_compute, t_memory)
             return PricedCandidate(
                 candidate, step, int(c.get("peak_bytes", c["bytes"])),

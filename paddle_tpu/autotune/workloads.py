@@ -748,6 +748,152 @@ def saved_model_workload(path: str, batch_size: int = 2
 
 
 # ---------------------------------------------------------------------------
+# mesh-layout workload (ISSUE 19: rank ICI-heavy vs DCN-heavy layouts)
+
+
+class _MeshRunner:
+    """One jitted training step of the layout's ParallelExecutor on
+    virtual CPU devices — the measured half when a real (non-mock)
+    measurer drives the mesh_layout axis."""
+
+    def __init__(self, exe, program, feeds, loss_name):
+        self._exe = exe
+        self._program = program
+        self._feeds = feeds
+        self._loss = loss_name
+        self._last = None
+
+    def step(self):
+        from ..framework.scope import Scope
+
+        if getattr(self, "_scope", None) is None:
+            self._scope = Scope()
+        self._last = self._exe.run(
+            self._program, feed=dict(self._feeds),
+            fetch_list=[self._loss], scope=self._scope, rng_step=0)
+
+    def barrier(self):
+        if self._last is not None:
+            np.asarray(self._last[0]).ravel()[:1]
+
+    def close(self):
+        pass
+
+
+class MeshLayoutWorkload:
+    """Multi-slice mesh layouts (slice count x per-slice ICI topology,
+    fixed 8-device fleet) for the Momentum-MLP step with weight-update
+    sharding active.  Every layout runs the same math — compute and
+    HBM traffic tie by construction — so the DIFFERENTIATOR is pure
+    communication: ``comm_cost`` prices each layout's collectives per
+    link class (a hybrid all-reduce decomposes into per-slice ICI
+    reduce-scatter -> DCN all-reduce -> ICI all-gather) and the prior
+    folds the wire time through `cost.roofline_with_comm`, ranking
+    ICI-heavy layouts (1x8) above DCN-heavy ones (4x2) exactly when
+    the analyzer says the DCN link dominates the step."""
+
+    kind = "mesh"
+    name = "mesh_layout"
+    LAYOUTS = ("1x8", "2x4", "4x2")
+
+    def __init__(self, batch_size: int = 64):
+        from ..parallel import modes as pmodes
+
+        self.batch_size = int(batch_size)
+        self._built = None
+        # must land before the tuner's platform(init=True) touches jax:
+        # every layout needs 8 (virtual) devices to build its Mesh
+        pmodes.ensure_virtual_devices(8)
+
+    def site(self) -> dict:
+        return {"workload": self.name, "devices": 8,
+                "model": "mlp_momentum_zero",
+                "batch_size": self.batch_size}
+
+    def space(self) -> _space.SearchSpace:
+        return _space.SearchSpace([
+            _space.Choice("mesh_layout.layout", list(self.LAYOUTS))])
+
+    def kernel_sites(self) -> Tuple:
+        return ()
+
+    def program_for(self, candidate):
+        return None  # priced analytically; comm_cost differentiates
+
+    def _program(self):
+        if self._built is None:
+            from ..parallel import modes as pmodes
+
+            mode, program, loss_name = pmodes.build_mode("dp")
+            self._built = (program, loss_name)
+        return self._built
+
+    @staticmethod
+    def _parse(layout: str) -> Tuple[int, int]:
+        slices, per_slice = (int(p) for p in str(layout).split("x"))
+        return slices, per_slice
+
+    def _mesh_for(self, layout):
+        from ..parallel.mesh import make_hybrid_mesh, make_mesh
+
+        slices, per_slice = self._parse(layout)
+        if slices == 1:
+            return make_mesh({"dp": per_slice})
+        return make_hybrid_mesh({"dp": per_slice}, {"dcn_dp": slices})
+
+    def analytic_cost(self, candidate, spec) -> dict:
+        from ..analysis import cost as _c
+
+        program, _ = self._program()
+        report = _c.program_cost(program, batch_size=self.batch_size,
+                                 chip=spec["chip"])
+        return {"flops": report["total_flops"],
+                "bytes": report["hbm_bytes"],
+                "devices": 8}
+
+    def comm_cost(self, candidate, spec) -> dict:
+        """The layout's priced collective footprint: plan the program
+        on the candidate mesh (weight-update sharding on), propagate,
+        and price per link class."""
+        from ..analysis.sharding import comm_report, propagate
+        from ..parallel.parallel_executor import ParallelExecutor
+
+        layout = candidate.get("mesh_layout.layout", self.LAYOUTS[0])
+        mesh = self._mesh_for(layout)
+        program, _ = self._program()
+        exe = ParallelExecutor(mesh=mesh, zero_dp_states=True)
+        plan = exe.static_plan(program)
+        ana = propagate(program, mesh=mesh, plan=plan,
+                        batch_size=self.batch_size)
+        return comm_report(ana, chip=spec["chip"])
+
+    def feasible(self, candidate, spec):
+        slices, per_slice = self._parse(
+            candidate.get("mesh_layout.layout", self.LAYOUTS[0]))
+        if slices * per_slice != 8:
+            return False, (f"layout {slices}x{per_slice} does not use "
+                           f"the fixed 8-device fleet")
+        if self.batch_size % (slices * per_slice):
+            return False, (f"batch {self.batch_size} not divisible by "
+                           f"{slices * per_slice} devices")
+        return True, ""
+
+    def build_runner(self, candidate) -> _MeshRunner:
+        from ..analysis.equivalence import build_feeds
+        from ..parallel.parallel_executor import ParallelExecutor
+
+        layout = candidate.get("mesh_layout.layout", self.LAYOUTS[0])
+        mesh = self._mesh_for(layout)
+        program, loss_name = self._program()
+        exe = ParallelExecutor(mesh=mesh, zero_dp_states=True)
+        block = program.global_block()
+        feed_names = sorted(n for n, v in block.vars.items()
+                            if v.is_data)
+        feeds = build_feeds(program, feed_names, self.batch_size)
+        return _MeshRunner(exe, program, feeds, loss_name)
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 WORKLOADS: Dict[str, Callable[[], object]] = {
@@ -763,6 +909,7 @@ WORKLOADS: Dict[str, Callable[[], object]] = {
     "spec_decode": SpecDecodeWorkload,
     "lstm": lambda: ProgramWorkload("lstm", _build_lstm, _lstm_space),
     "mlp_depth": MlpDepthWorkload,
+    "mesh_layout": MeshLayoutWorkload,
 }
 
 

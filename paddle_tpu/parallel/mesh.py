@@ -89,6 +89,55 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
     return Mesh(arr, axis_names=names)
 
 
+def make_hybrid_mesh(ici_axes: Dict[str, int],
+                     dcn_axes_map: Dict[str, int], devices=None):
+    """Build a multi-slice Mesh: outer `dcn*` axes across slices, inner
+    axes within each slice's ICI domain (the create_hybrid_device_mesh
+    shape from t5x/maxtext).
+
+    `dcn_axes_map` names MUST carry the ``dcn`` prefix — that prefix is
+    the contract by which `dcn_axes`, PTV021, `comm_report`, and the
+    ICI-reduce-scatter → DCN-all-reduce → ICI-all-gather decomposition
+    recognize slow links; an unprefixed slice axis would silently be
+    priced at ICI bandwidth.
+
+    On real multi-slice TPU, devices are grouped by their
+    ``slice_index`` attribute so the outer mesh dims walk slices.  On
+    CPU/simulated-DCN there are no slice indices: devices are split
+    into `num_slices` contiguous chunks, so a 2-slice run over 8
+    virtual devices models devices 0-3 as slice 0 and 4-7 as slice
+    1."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    for name in dcn_axes_map:
+        if not str(name).startswith("dcn"):
+            raise ValueError(
+                f"hybrid mesh slice axis {name!r} must carry the 'dcn' "
+                f"prefix (the analyzer's link-class convention)")
+    devices = list(devices if devices is not None else jax.devices())
+    num_slices = int(np.prod(list(dcn_axes_map.values()) or [1]))
+    per_slice = int(np.prod(list(ici_axes.values()) or [1]))
+    total = num_slices * per_slice
+    if total > len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_axes_map} x {ici_axes} needs {total} "
+            f"devices, have {len(devices)}")
+    devices = devices[:total]
+    names = list(dcn_axes_map.keys()) + list(ici_axes.keys())
+    sizes = ([int(dcn_axes_map[n]) for n in dcn_axes_map]
+             + [int(ici_axes[n]) for n in ici_axes])
+    if all(getattr(d, "slice_index", None) is not None for d in devices) \
+            and len({d.slice_index for d in devices}) == num_slices:
+        # real multi-slice: group by physical slice so the outer (dcn)
+        # mesh dims walk slices and the inner dims stay intra-slice ICI
+        devices = sorted(devices, key=lambda d: (d.slice_index, d.id))
+    # else simulated DCN: contiguous chunks stand in for slices
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, axis_names=names)
+
+
 def get_shard_map():
     """Version-portable shard_map import (moved to jax.* in 0.8).
 

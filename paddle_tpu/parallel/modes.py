@@ -325,22 +325,25 @@ def logical_plan(mode: ParallelMode, program, mesh):
             f"mesh axes {mesh_axis_sizes(mesh)} do not match mode "
             f"{mode.name!r} ({dict(mode.mesh_axes)}) — a mismatched "
             f"pair would compare the wrong declaration")
-    lp = LogicalPartitioner(rules=standard_logical_axis_rules())
+    kw = dict(mode.executor_kwargs)
+    lp = LogicalPartitioner(rules=standard_logical_axis_rules(
+        zero_dp_states=bool(kw.get("zero_dp_states")),
+        fsdp_params=bool(kw.get("fsdp_params"))))
     return lp, lp.plan(program, mesh)
 
 
 def mode_plan(mode: ParallelMode, program, devices=None):
     """(mesh, plan, provenance) for one mode: the EFFECTIVE shardings
-    its executor would constrain, from descs alone.  Pipeline modes get
-    an empty plan (stage splitting is not a NamedSharding story); the
-    analyzer prices their stage boundaries via the pipeline_stage
-    markers instead."""
+    its executor would constrain, from descs alone.  Pipeline modes
+    plan like every other mode (rule family 4: ProgramPipeline shards
+    microbatch feeds over 'dp' at runtime — `feeds_spec = P(None,
+    'dp')` — so the static plan declares the same batch-led feeds;
+    stage-split params stay replicated in the plan and the analyzer
+    prices the stage boundaries via the pipeline_stage markers)."""
     from .mesh import make_mesh
     from .parallel_executor import ParallelExecutor
 
     mesh = make_mesh(dict(mode.mesh_axes), devices=devices)
-    if mode.pipeline:
-        return mesh, {}, {}
     pe = ParallelExecutor(mesh=mesh, **dict(mode.executor_kwargs))
     provenance: Dict[str, str] = {}
     plan = pe.static_plan(program, provenance=provenance)

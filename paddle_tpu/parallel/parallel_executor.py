@@ -9,6 +9,17 @@ jitted with NamedShardings: batch-sharded feeds ('dp'), optionally
 tensor-sharded weights ('mp'), replicated small state.  XLA GSPMD partitions
 the computation and emits ICI collectives (gradient all-reduce appears
 automatically from the replicated-param + sharded-batch math).
+
+Since the partitioner collapse (ROADMAP #1) the executor holds NO sharding
+logic of its own: the transpiler's logical-axis rule table produces every
+spec — including the ZeRO-1/FSDP dim-0 reshards that used to live here as
+`_maybe_zero_shard` — and the executor only applies the plan (device_put,
+in_shardings/out_shardings, donation).  The `zero_dp_states`/`fsdp_params`
+kwargs survive as rule-table flags (arXiv:2004.13336 cross-replica
+weight-update sharding: the optimizer step runs on the dim-0 shard and
+GSPMD all-gathers params once per step); the deleted wiring's behaviour is
+archived in parallel/mode_plans_golden.json and every mode's rule-driven
+plan is PROVEN equal to it by `analysis.equivalence.mode_plan_equivalence`.
 """
 
 from __future__ import annotations
@@ -33,48 +44,28 @@ class ParallelExecutor(Executor):
         super().__init__(place=None)
         self._pin_device = False
         self.mesh = mesh if mesh is not None else make_mesh(axes, devices)
-        self.transpiler = DistributeTranspiler(rules)
-        self._plans: Dict[int, Dict[str, object]] = {}
-        # ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336):
-        # optimizer accumulators are sharded over 'dp' so each replica stores
-        # and updates 1/dp of the optimizer state; GSPMD turns the gradient
-        # all-reduce into reduce-scatter + post-update param all-gather
-        self.zero_dp_states = bool(zero_dp_states)
-        # ZeRO-3 / FSDP: TRAINABLE parameters themselves shard over 'dp'
-        # on dim 0 (1/dp weight residency per device); GSPMD inserts the
-        # forward/backward all-gathers and grad reduce-scatters — the
-        # sharding-annotation route, no hand-written collectives.  Implies
-        # accumulator sharding (they follow their parameter's sharding).
-        self.fsdp_params = bool(fsdp_params)
-        if fsdp_params:
-            self.zero_dp_states = True
-        self._active_scope = None
-        # positive identification: ZeRO reshards ONLY variables tagged
-        # `accumulator_for` by Optimizer._add_accumulator — never model state
-        # like batch-norm running stats, nor a user param whose name happens
-        # to extend another param's name with '_'
-        self._accum_owner: Dict[str, str] = {}
-        self._trainable_params: set = set()
+        self.transpiler = DistributeTranspiler(
+            rules, zero_dp_states=zero_dp_states, fsdp_params=fsdp_params)
+        self._plans: Dict[int, tuple] = {}
+        self.zero_dp_states = self.transpiler.rules.zero_dp_states
+        self.fsdp_params = self.transpiler.rules.fsdp_params
 
     # ------------------------------------------------------------------
     def _plan_for(self, program):
+        """(plan, provenance) for `program`, cached per desc version."""
         key = (program._cache_token, program._version)
-        plan = self._plans.get(key)
-        if plan is None:
+        entry = self._plans.get(key)
+        if entry is None:
             plan = self.transpiler.transpile(program, self.mesh)
-            self._plans[key] = plan
-            self._accum_owner.update({
-                v.name: v.accumulator_for
-                for v in program.global_block().vars.values()
-                if getattr(v, "accumulator_for", None)})
-            self._trainable_params.update(
-                v.name for v in program.global_block().vars.values()
-                if v.persistable and getattr(v, "trainable", False))
+            entry = (plan, dict(self.transpiler.last_provenance))
+            self._plans[key] = entry
             # an accumulator-free optimizer (plain SGD) under fsdp_params
             # is working as intended — params are the sharded state — so
             # the missing-tag warning only applies to explicit ZeRO-1
             if (self.zero_dp_states and not self.fsdp_params
-                    and not self._accum_owner
+                    and not any(
+                        getattr(v, "accumulator_for", None)
+                        for v in program.global_block().vars.values())
                     and any(op.type.endswith("_grad") or
                             op.type == "generic_grad"
                             for op in program.global_block().ops)):
@@ -84,117 +75,54 @@ class ParallelExecutor(Executor):
                     "zero_dp_states=True but no variable carries an "
                     "accumulator_for tag (program saved by an older build?) "
                     "— optimizer state will stay replicated")
-        return plan
+        return entry
 
     def _replicated(self):
         return mesh_lib.replicated(self.mesh)
 
-    def _shard_of(self, plan, name, prov=None):
+    def _shard_of(self, plan, name):
         s = plan.get(name)
-        if s is not None:
-            return self._maybe_zero_shard(name, s, prov)
-        # optimizer accumulators follow their parameter (positive tag from
-        # Optimizer._add_accumulator, carried on the VarDesc)
-        owner = self._accum_owner.get(name)
-        if owner is not None and owner in plan:
-            if prov is not None:
-                prov[name] = (f"accumulator follows parameter "
-                              f"{owner!r}")
-            return self._maybe_zero_shard(name, plan[owner], prov)
-        return self._replicated()
-
-    def _maybe_zero_shard(self, name, sharding, prov=None):
-        """ZeRO-1: shard an optimizer accumulator (a var positively tagged
-        by the optimizer) over the replica axis on dim 0 when divisible.
-        ZeRO-3 (fsdp_params): trainable parameters shard the same way —
-        GSPMD then all-gathers them for compute and reduce-scatters their
-        gradients, giving 1/dp weight residency with identical numerics.
-        `prov` (optional dict) collects WHICH rule produced each spec —
-        the static_plan provenance the PTV016 findings cite."""
-        if not self.zero_dp_states:
-            return sharding
-        if name not in self._accum_owner and not (
-                self.fsdp_params and name in self._trainable_params):
-            return sharding
-        rules = self.transpiler.rules
-        dp_axis = rules.dp_axis
-        dp = rules._axis_size(self.mesh, dp_axis)
-        shape = self._state_shape(name)
-        spec = tuple(sharding.spec)
-        if (dp > 1 and shape and len(shape) >= 1
-                and shape[0] % dp == 0 and shape[0] >= dp
-                and (not spec or spec[0] is None)):
-            if prov is not None:
-                kind = ("FSDP/ZeRO-3 parameter shard"
-                        if name in self._trainable_params
-                        and self.fsdp_params
-                        else "ZeRO-1 accumulator reshard")
-                prov[name] = (f"{kind} over {dp_axis!r} on dim 0 "
-                              f"(axis size {dp})")
-            return mesh_lib.named(self.mesh, dp_axis,
-                                  *(spec[1:] if spec else ()))
-        return sharding
-
-    def _state_shape(self, name):
-        scope = self._active_scope
-        if scope is not None:
-            v = scope.find(name)
-            if v is not None:
-                return tuple(v.shape)
-        # desc fallback (static_plan runs before any scope state exists):
-        # -1 batch markers never appear on persistable state, so the
-        # declared shape is the real one
-        blk = getattr(self, "_desc_block", None)
-        if blk is not None:
-            dv = blk._find_var_recursive(name)
-            if dv is not None and dv.shape is not None:
-                return tuple(dv.shape)
-        return None
+        return s if s is not None else self._replicated()
 
     def static_plan(self, program, block_id: int = 0, provenance=None):
-        """EFFECTIVE per-variable shardings — the transpiler plan plus
-        the ZeRO-1/FSDP accumulator+parameter resharding — from descs
-        alone: no scope, no compilation, nothing runs.  This is the
-        `plan=` input to `analysis.verify_program` (sharded-donation
-        rule PTV016, sharding-propagation rules PTV018-021),
+        """EFFECTIVE per-variable shardings from descs alone: no scope,
+        no compilation, nothing runs.  Just the rule-table plan
+        restricted to the persistable/feed vars the block touches — the
+        ZeRO-1/FSDP reshards are table rows now, not an executor
+        post-pass.  This is the `plan=` input to
+        `analysis.verify_program` (sharded-donation rule PTV016,
+        sharding-propagation rules PTV018-021),
         `analysis.memory.peak_estimate(per-shard)`, and
         `analysis.sharding.propagate`.  Pass `provenance={}` to collect
         {var: which rule produced the spec} — verify_program's
         `plan_provenance` input, so PTV016 findings name the axis rule
         that made the donated state sharded."""
         block = program.blocks[block_id]
-        plan = self._plan_for(program)
-        self._desc_block = block
-        try:
-            names = set()
-            for op in block.ops:
-                names.update(n for n in op.input_names() if n)
-                names.update(n for n in op.output_names() if n)
-            out = {}
-            for n in sorted(names):
-                v = block._find_var_recursive(n)
-                if v is None or not (v.persistable or v.is_data):
-                    # only the vars the executor actually CONSTRAINS:
-                    # transient shardings are GSPMD propagation, and a
-                    # replicated placeholder here would override the
-                    # estimator's batch-led heuristic with a lie
-                    continue
-                out[n] = self._shard_of(plan, n, provenance)
-                if provenance is not None and n not in provenance:
-                    spec = tuple(out[n].spec)
-                    if any(e for e in spec):
-                        provenance[n] = self.transpiler.rules.describe(
-                            v, spec)
-            return out
-        finally:
-            self._desc_block = None
+        plan, prov = self._plan_for(program)
+        names = set()
+        for op in block.ops:
+            names.update(n for n in op.input_names() if n)
+            names.update(n for n in op.output_names() if n)
+        out = {}
+        for n in sorted(names):
+            v = block._find_var_recursive(n)
+            if v is None or not (v.persistable or v.is_data):
+                # only the vars the executor actually CONSTRAINS:
+                # transient shardings are GSPMD propagation, and a
+                # replicated placeholder here would override the
+                # estimator's batch-led heuristic with a lie
+                continue
+            out[n] = self._shard_of(plan, n)
+            if provenance is not None and n in prov:
+                provenance.setdefault(n, prov[n])
+        return out
 
     # ------------------------------------------------------------------
     def _prepare_feeds(self, block, feed):
         import jax
 
         program = block.program
-        plan = self._plan_for(program)
+        plan, _ = self._plan_for(program)
         out = {}
         for name, value in feed.items():
             if isinstance(value, jax.Array):
@@ -220,7 +148,7 @@ class ParallelExecutor(Executor):
         executable's in_shardings demand the planned one."""
         import jax
 
-        plan = self._plan_for(program)
+        plan, _ = self._plan_for(program)
         for n in names:
             v = scope.find(n)
             if v is None:
@@ -237,7 +165,6 @@ class ParallelExecutor(Executor):
 
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
-        self._active_scope = scope  # accumulator shapes for zero sharding
         block = program.blocks[block_id]
         # pre-shard all scope state the block touches
         names = set()
@@ -261,7 +188,7 @@ class ParallelExecutor(Executor):
             op.type.endswith("_grad") or op.type == "generic_grad"
             for op in block.ops
         )
-        plan = self._plan_for(program)
+        plan, _ = self._plan_for(program)
 
         def step_fn(state_w, state_r, feeds, rng_key):
             env = {}

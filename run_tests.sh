@@ -22,6 +22,23 @@ JAX_PLATFORMS=cpu python tools/lint_smoke.py
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m paddle_tpu analyze --sharding > /dev/null
 
+# plan-equivalence gate (ISSUE 19): the 11-mode sweep must be 11/11
+# PROVEN against the archived bespoke plans (the prove_equivalent
+# obligation for the deleted partitioner wiring) — exits 1 on any
+# DIVERGED entry; desc-only, nothing compiles
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/hlo_analysis.py equiv > /dev/null \
+    || { echo "plan-equivalence gate failed: a mode DIVERGED from the \
+archived bespoke plan (rc=$?)"; exit 1; }
+
+# hybrid-mesh parity gate (ISSUE 19): 2-slice simulated-DCN training
+# step must match single-slice BITWISE (differential oracle, rtol=0)
+# with weight-update sharding active; also the bench artifact for
+# predicted wire bytes per link class (ICI vs DCN)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/hlo_analysis.py hybrid > /dev/null \
+    || { echo "hybrid-mesh bitwise parity gate failed (rc=$?)"; exit 1; }
+
 # telemetry smoke (docs/observability.md ISSUE 13): a traced fit-a-line
 # train step through the unified telemetry layer — asserts the executor
 # phase spans exist, the Perfetto trace and metrics snapshot are
@@ -40,6 +57,12 @@ env JAX_PLATFORMS=cpu python -m paddle_tpu tune gpt_small --smoke \
 # same loop: rank by the cost model, measure the survivors, persist
 env JAX_PLATFORMS=cpu python -m paddle_tpu tune spec_decode --smoke \
     || { echo "spec_decode autotune smoke failed (rc=$?)"; exit 1; }
+# the ISSUE 19 mesh_layout axis: slice-count x per-slice topology priced
+# by roofline_with_comm (ICI-heavy vs DCN-heavy layouts ranked by the
+# per-link-class wire model)
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m paddle_tpu tune mesh_layout --smoke \
+    || { echo "mesh_layout autotune smoke failed (rc=$?)"; exit 1; }
 
 # attribution smoke + regression sentinel (docs/observability.md ISSUE
 # 16): `paddle attribute` runs the deterministic CPU segment oracle

@@ -484,34 +484,31 @@ def test_plan_equivalence_covers_all_modes():
             for d in r["spec_diffs"]:
                 assert d["var"] and "bespoke" in d and "logical" in d
                 assert d["bespoke_rule"]
-    # the logical-axis table already fully expresses pure-dp and the
-    # catalog's replicated-dense modes — the collapse floor
-    verdicts = {r["mode"]: r["verdict"] for r in report}
-    assert verdicts["dp"] == "PROVEN"
-    assert verdicts["host_emb"] == "PROVEN"
+    # ISSUE 19: the partitioner collapse is done — the floor is the
+    # whole catalog, PROVEN against the golden archive of the deleted
+    # bespoke wiring
+    assert all(r["verdict"] == "PROVEN" for r in report), \
+        [(r["mode"], r["verdict"]) for r in report]
+    assert all(r["golden"] for r in report)
 
 
-def test_plan_equivalence_zero_fsdp_gap_is_the_crash_rule():
-    """The dp_mp (ZeRO-1) and fsdp modes diverge from the logical
-    declaration EXACTLY on the dim-0 dp state reshard — the same rule
-    the PTV016 crash-triage findings cite for the 3 isolation-skip
-    test_parallel programs, now with the diverging collective footprint
-    quantified."""
-    rec = eqv.mode_plan_equivalence("dp_mp")
-    assert rec["verdict"] == "DIVERGED"
-    zero_diffs = [d for d in rec["spec_diffs"]
-                  if "ZeRO-1 accumulator reshard" in d["bespoke_rule"]]
-    assert zero_diffs and all(d["bespoke"][:1] == ["dp"]
-                              for d in zero_diffs)
-    assert "all-gather" in rec["comm"]["delta"]  # the gather-back cost
-
-    rec2 = eqv.mode_plan_equivalence("fsdp")
-    assert rec2["verdict"] == "DIVERGED"
-    fsdp_diffs = [d for d in rec2["spec_diffs"]
-                  if "FSDP/ZeRO-3 parameter shard" in d["bespoke_rule"]]
-    assert fsdp_diffs and all(d["bespoke"][:1] == ["dp"]
-                              for d in fsdp_diffs)
-    assert "all-gather" in rec2["comm"]["delta"]
+def test_plan_equivalence_zero_fsdp_gap_closed():
+    """The dp_mp (ZeRO-1) and fsdp modes used to diverge from the
+    logical declaration EXACTLY on the dim-0 dp state reshard — the
+    same rule the PTV016 crash-triage findings cite for the 3
+    isolation-skip test_parallel programs.  ISSUE 19 closed the gap:
+    the ("state0", dp)/("param0", dp) rule families landed, the
+    bespoke wiring is deleted, and both modes are PROVEN against its
+    archived plans.  The old divergence stays pinned by the mutation
+    tests (test_sharding.py::test_zero_state_rule_removed_
+    reopens_pr10_diff and test_fsdp_param_rule_removed_reopens_
+    pr10_diff): remove the rule and the archived diff reappears."""
+    for name in ("dp_mp", "fsdp"):
+        rec = eqv.mode_plan_equivalence(name)
+        assert rec["verdict"] == "PROVEN", (name, rec)
+        assert rec["golden"], "golden archive missing"
+        assert not rec["executor_diffs"]  # executor tracks the table
+        assert not rec["comm"]["delta"]   # gather-back bytes archived
 
 
 def test_hlo_analysis_equiv_mode_emits_json():

@@ -191,15 +191,15 @@ def test_zero_dp_optimizer_state_sharding():
     flags exactly this shape — see
     test_analysis.py::test_known_crash_parallel_programs_flagged_ptv016.
 
-    PLAN-EQUIVALENCE finding (ISSUE 10, analysis/equivalence.py): the
-    sharding rule behind the hazard — "ZeRO-1 accumulator reshard over
-    'dp' on dim 0" (PR 9 provenance) — is also exactly where this
-    program's bespoke plan DIVERGES from its logical-axis declaration:
-    the reshard implies extra all-gather traffic (the optimizer-state
-    gather-back) the logical table does not, quantified per-kind by the
-    crash-triage half of the test above.  Until the logical table grows
-    a ZeRO state rule, this mode cannot collapse into rule declarations
-    (ROADMAP #2 go/no-go: `tools/hlo_analysis.py equiv`, mode dp_mp)."""
+    PLAN-EQUIVALENCE (ISSUE 10 finding, closed by ISSUE 19): the rule
+    behind the hazard — "ZeRO-1 accumulator reshard over 'dp' on dim 0"
+    — used to be exactly where the bespoke plan diverged from its
+    logical-axis declaration.  The logical table now carries it as the
+    ("state0", dp) family, the bespoke wiring is deleted, and the mode
+    is PROVEN against the archived plan (parallel/mode_plans_golden
+    .json; `tools/hlo_analysis.py equiv`, 11/11).  test_sharding.py::
+    test_zero_state_rule_removed_reopens_pr10_diff guards the rule:
+    remove it and the archived diff reappears verbatim."""
     import jax
     import numpy as np
     import paddle_tpu as fluid
@@ -483,13 +483,12 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     Statically detected: test_analysis.py::
     test_known_crash_parallel_programs_flagged_ptv016.
 
-    PLAN-EQUIVALENCE finding (ISSUE 10): the hazard's sharding rule
-    ("ZeRO-1 accumulator reshard over 'dp' on dim 0") is the same
-    rule on which the dp×mp bespoke plan diverges from its logical-axis
-    declaration — extra all-gather bytes (state gather-back) the
-    logical table lacks a rule for; see the crash-triage footprint
-    assertions in test_known_crash_parallel_programs_flagged_ptv016 and
-    `tools/hlo_analysis.py equiv` (mode dp_mp, verdict DIVERGED)."""
+    PLAN-EQUIVALENCE (ISSUE 10 finding, closed by ISSUE 19): the
+    hazard's rule ("ZeRO-1 accumulator reshard over 'dp' on dim 0") is
+    now the ("state0", dp) logical family; the dp×mp mode it used to
+    diverge on is PROVEN against the archived bespoke plan
+    (`tools/hlo_analysis.py equiv`, mode dp_mp) and mutation-guarded by
+    test_sharding.py::test_zero_state_rule_removed_reopens_pr10_diff."""
     from paddle_tpu.distributed import checkpoint as ckpt
 
     def build():
@@ -741,7 +740,8 @@ def test_fsdp_leaves_frozen_params_replicated():
     pe.run(fluid.default_startup_program())
     xs, ys = _data(16)
     pe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
-    assert "frozen.w" not in pe._trainable_params
+    plan = pe.static_plan(fluid.default_main_program())
+    assert not any(e for e in plan["frozen.w"].spec), plan["frozen.w"]
     w = fluid.global_scope().find("frozen.w")
     assert tuple(w.sharding.spec) in ((), (None,), (None, None)), \
         w.sharding.spec
@@ -764,13 +764,12 @@ def test_sharded_checkpoint_roundtrip_fsdp(tmp_path):
     detected: test_analysis.py::
     test_known_crash_parallel_programs_flagged_ptv016.
 
-    PLAN-EQUIVALENCE finding (ISSUE 10): the hazard's sharding rule
-    ("FSDP/ZeRO-3 parameter shard over 'dp' on dim 0") is where the
-    fsdp bespoke plan diverges from its logical-axis declaration — the
-    forward/backward parameter all-gathers have no logical-table rule
-    yet; see the crash-triage footprint assertions in
-    test_known_crash_parallel_programs_flagged_ptv016 and
-    `tools/hlo_analysis.py equiv` (mode fsdp, verdict DIVERGED)."""
+    PLAN-EQUIVALENCE (ISSUE 10 finding, closed by ISSUE 19): the
+    hazard's rule ("FSDP/ZeRO-3 parameter shard over 'dp' on dim 0")
+    is now the ("param0", dp) logical family; the fsdp mode it used to
+    diverge on is PROVEN against the archived bespoke plan
+    (`tools/hlo_analysis.py equiv`, mode fsdp) and mutation-guarded by
+    test_sharding.py::test_fsdp_param_rule_removed_reopens_pr10_diff."""
     from paddle_tpu.distributed import checkpoint as ckpt
 
     def build():
@@ -804,3 +803,30 @@ def test_sharded_checkpoint_roundtrip_fsdp(tmp_path):
                                     fetch_list=[avg])[0]).reshape(-1)[0])
            for _ in range(3)]
     np.testing.assert_allclose(got, expect, rtol=2e-4, atol=1e-5)
+
+
+@isolated_native("parallel_tail_5")
+def test_hybrid_two_slice_mesh_bitwise_parity():
+    """ISSUE 19 hybrid meshes: the same dp-MLP training step on a flat
+    {dp: 8} mesh and on a 2-slice simulated-DCN {dcn_dp: 2, dp: 4} mesh
+    — with ZeRO-1 weight-update sharding active on both — must match
+    BITWISE (rtol=0, atol=0, the PR 10 differential oracle).  The tuple
+    rule ("state0", ("dcn_dp", "dp")) shards dim 0 eight ways over the
+    same device order as the flat mesh, so XLA lowers identical
+    collectives and exact equality is the honest bar, not a tolerance.
+
+    Isolated (PTV016 family): both executors donate dp-sharded
+    optimizer state."""
+    from paddle_tpu.analysis import equivalence as eqv
+
+    rep = eqv.hybrid_parity_report(batch_size=8)
+    assert rep["verdict"] == "PROVEN", rep["findings"]
+    assert rep["bitwise"] is True
+    assert rep["weight_update_sharding"] is True
+    # the hybrid plan really used the two-axis spec on the accumulators
+    for name, spec in rep["velocity_specs_hybrid"].items():
+        assert spec and spec[0] == ["dcn_dp", "dp"], (name, spec)
+    # and the comm analyzer split the wire bytes across link classes
+    lb = rep["comm"]["hybrid"]["link_bytes"]
+    assert lb["ici"] > 0 and lb["dcn"] > 0
+    assert rep["comm"]["single"]["link_bytes"]["dcn"] == 0

@@ -411,6 +411,208 @@ def test_mode_catalog_is_the_eleven_dryrun_modes():
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 19: rule-family mutation tests — rule present -> PROVEN against
+# the archived bespoke plans, rule removed -> the exact PR 10 diff
+# reappears.  The mutation swaps `standard_logical_axis_rules` for a
+# filtered table; both the executor's transpiler and the bare
+# LogicalPartitioner read it through late imports, so the two live
+# plans stay consistent and the divergence shows up ONLY against the
+# golden archive — exactly how a silently dropped rule would present.
+
+
+def _mutate_rules(monkeypatch, mutate):
+    real = ash.standard_logical_axis_rules
+
+    def wrapped(*a, **kw):
+        return mutate(list(real(*a, **kw)))
+
+    monkeypatch.setattr(ash, "standard_logical_axis_rules", wrapped)
+
+
+def _equiv(name):
+    from paddle_tpu.analysis import equivalence as eqv
+
+    return eqv.mode_plan_equivalence(name)
+
+
+@pytest.mark.parametrize("name", ["dp_mp", "fsdp", "sp_ring", "emb_mp",
+                                  "pp_dp"])
+def test_rule_family_modes_proven_against_golden(name):
+    """Rule present: the modes the 4 new rule families unlocked are
+    PROVEN equal to the deleted wiring's archived plans (the other
+    modes ride the full 11/11 run_tests.sh gate)."""
+    _mesh8()
+    rec = _equiv(name)
+    assert rec["golden"], "parallel/mode_plans_golden.json missing"
+    assert rec["verdict"] == "PROVEN", rec
+
+
+def test_zero_state_rule_removed_reopens_pr10_diff(monkeypatch):
+    """Family 1 (ZeRO-1 dim-0 optimizer-state reshard): drop the
+    state0/param0 dp rows and dp_mp diverges from the archive exactly
+    where PR 10 said — accumulators replicated instead of dim-0
+    sharded, and the weight-update-sharding all-gathers gone."""
+    _mesh8()
+    _mutate_rules(monkeypatch, lambda rules: [
+        r for r in rules
+        if not (r[0] in ("state0", "param0") and r[1] is not None)])
+    rec = _equiv("dp_mp")
+    assert rec["verdict"] == "DIVERGED"
+    assert not rec["executor_diffs"]  # both live plans lost the rule
+    vel = [d for d in rec["spec_diffs"] if "velocity" in d["var"]]
+    assert vel, rec["spec_diffs"]
+    for d in vel:
+        assert d["bespoke"][0] == "dp" and d["logical"] == []
+    assert rec["comm"]["delta"]
+
+
+def test_fsdp_param_rule_removed_reopens_pr10_diff(monkeypatch):
+    """Family 1, fsdp face: without the param0/state0 rows every
+    trainable param falls back to replicated — the PR 10 fsdp diff
+    (params+velocities ['dp'] vs [])."""
+    _mesh8()
+    _mutate_rules(monkeypatch, lambda rules: [
+        r for r in rules
+        if not (r[0] in ("state0", "param0") and r[1] is not None)])
+    rec = _equiv("fsdp")
+    assert rec["verdict"] == "DIVERGED"
+    dropped = [d for d in rec["spec_diffs"]
+               if d["bespoke"] and d["bespoke"][0] == "dp"
+               and d["logical"] == []]
+    assert dropped, rec["spec_diffs"]
+    assert rec["comm"]["delta"]
+
+
+def test_length_rule_removed_reopens_pr10_diff(monkeypatch):
+    """Family 2 (op-internal sequence parallelism as a `length` feed
+    rule): drop it and sp_ring's feeds lose the sp dim — the PR 10
+    seq/tokens diff (['dp','sp'] vs ['dp'])."""
+    _mesh8()
+    _mutate_rules(monkeypatch,
+                  lambda rules: [r for r in rules if r[0] != "length"])
+    rec = _equiv("sp_ring")
+    assert rec["verdict"] == "DIVERGED"
+    assert rec["spec_diffs"]
+    for d in rec["spec_diffs"]:
+        assert d["bespoke"][:2] == ["dp", "sp"]
+        assert d["logical"] == ["dp"]
+
+
+def test_column_parallel_gate_removed_reopens_pr10_diff(monkeypatch):
+    """Family 3 (the >=128 column-parallel width threshold): un-gate
+    the mlp row and emb_mp's 8-wide fc shards where the bespoke wiring
+    (and the archive) kept it replicated — the PR 10 fc_0.w_0 diff
+    ([] vs [None,'mp'])."""
+    _mesh8()
+    _mutate_rules(monkeypatch, lambda rules: [
+        (r[0], r[1]) if len(r) == 3 else r for r in rules])
+    rec = _equiv("emb_mp")
+    assert rec["verdict"] == "DIVERGED"
+    d = next(d for d in rec["spec_diffs"] if d["var"] == "fc_0.w_0")
+    assert d["bespoke"] == [] and d["logical"][-1] == "mp"
+    assert rec["comm"]["delta"]
+
+
+def test_microbatch_dp_rule_removed_reopens_pr10_diff(monkeypatch):
+    """Family 4 (pipeline-driven microbatch dp): drop the batch row and
+    pp_dp's microbatch feeds lose dp — the PR 10 x/y diff — and the
+    stage-boundary permutes grow back to full-batch bytes."""
+    _mesh8()
+    _mutate_rules(monkeypatch,
+                  lambda rules: [r for r in rules if r[0] != "batch"])
+    rec = _equiv("pp_dp")
+    assert rec["verdict"] == "DIVERGED"
+    assert {d["var"] for d in rec["spec_diffs"]} >= {"x", "y"}
+    for d in rec["spec_diffs"]:
+        assert d["bespoke"] == ["dp"] and d["logical"] == []
+    assert rec["comm"]["delta"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: hybrid ICI x DCN collective-bytes exactness
+
+
+def test_hybrid_allreduce_decomposition_bytes_exact():
+    """One all-reduce over ("dcn_dp","dp") on a 2-slice 4x mesh prices
+    as the hierarchical decomposition, byte-exact: ICI carries the flat
+    all-reduce wire bytes (RS+AG legs), DCN carries 2(n_d-1)/n_d of the
+    1/n_ici reduce-scattered shard."""
+    b = 1 << 20
+    ana = ash.ShardingAnalysis(axis_sizes={"dp": 4, "dcn_dp": 2})
+    ana.collectives.append(
+        ash.Collective("all-reduce", ("dcn_dp", "dp"), b))
+    rep = ash.comm_report(ana, chip="v5e")
+    w_ici = 2 * (4 - 1) / 4 * b
+    w_dcn = 2 * (2 - 1) / 2 * (b // 4)
+    assert rep["link_bytes"] == {"ici": int(w_ici), "dcn": int(w_dcn)}
+    dec = rep["breakdown"][0]["decomposed"]
+    assert dec["ici_reduce_scatter_bytes"] == (4 - 1) * (b // 4)
+    assert dec["dcn_all_reduce_bytes"] == int(w_dcn)
+    assert dec["ici_all_gather_bytes"] == int((4 - 1) / 4 * b)
+    # the three stages' ICI legs sum to the flat-all-reduce wire bytes
+    assert (dec["ici_reduce_scatter_bytes"]
+            + dec["ici_all_gather_bytes"]) == int(w_ici)
+    # pure single-class collectives don't decompose
+    ana2 = ash.ShardingAnalysis(axis_sizes={"dp": 4, "dcn_dp": 2})
+    ana2.collectives.append(ash.Collective("all-reduce", ("dp",), b))
+    ana2.collectives.append(ash.Collective("all-reduce", ("dcn_dp",), b))
+    rep2 = ash.comm_report(ana2, chip="v5e")
+    assert all("decomposed" not in e for e in rep2["breakdown"])
+    assert rep2["link_bytes"]["ici"] == int(2 * 3 / 4 * b)
+    assert rep2["link_bytes"]["dcn"] == int(2 * 1 / 2 * b)
+
+
+def test_hybrid_mesh_step_link_bytes_per_collective():
+    """The dp-MLP training step planned on the 2-slice mesh: every
+    gradient all-reduce spans both link classes and its breakdown entry
+    matches the decomposition formula row by row (ICI vs DCN bytes per
+    step, the ISSUE 19 exactness contract)."""
+    _mesh8()
+    from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+    mode, prog, _loss = pmodes.build_mode("dp")
+    mesh = make_hybrid_mesh({"dp": 4}, {"dcn_dp": 2})
+    pe = ParallelExecutor(mesh=mesh, zero_dp_states=True)
+    ana = ash.propagate(prog, mesh=mesh, plan=pe.static_plan(prog),
+                        batch_size=8)
+    rep = ash.comm_report(ana)
+    hybrid_ars = [e for e in rep["breakdown"]
+                  if e["kind"] == "all-reduce"
+                  and set(e["axes"]) == {"dcn_dp", "dp"}]
+    assert hybrid_ars, rep["breakdown"]
+    for e in hybrid_ars:
+        b = e["bytes"]
+        dec = e["decomposed"]
+        assert dec["ici_reduce_scatter_bytes"] == 3 * (b // 4)
+        assert dec["dcn_all_reduce_bytes"] == int(2 * (1 / 2) * (b // 4))
+        assert dec["ici_all_gather_bytes"] == int(3 / 4 * b)
+    assert rep["link_bytes"]["ici"] > 0
+    assert rep["link_bytes"]["dcn"] > 0
+    # DCN carries strictly less than ICI: only 1/n_ici shards cross it
+    assert rep["link_bytes"]["dcn"] < rep["link_bytes"]["ici"]
+
+
+def test_make_hybrid_mesh_shape_and_prefix_contract():
+    _mesh8()
+    from paddle_tpu.parallel.mesh import (dcn_axes, make_hybrid_mesh,
+                                          mesh_axis_sizes)
+
+    mesh = make_hybrid_mesh({"dp": 4}, {"dcn_dp": 2})
+    assert mesh_axis_sizes(mesh) == {"dcn_dp": 2, "dp": 4}
+    assert dcn_axes(mesh) == ("dcn_dp",)
+    # outer dim walks slices: each row is one slice's contiguous chunk
+    import jax
+
+    devs = jax.devices()[:8]
+    assert list(mesh.devices[0].ravel()) == devs[:4]
+    assert list(mesh.devices[1].ravel()) == devs[4:]
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dp": 4}, {"slices": 2})  # missing dcn prefix
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dp": 8}, {"dcn_dp": 2})  # 16 > 8 devices
+
+
+# ---------------------------------------------------------------------------
 # analyze CLI (--sharding)
 
 

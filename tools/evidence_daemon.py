@@ -345,6 +345,13 @@ CAPTURES = [
     # partitioner-collapse decision always cites a current sweep
     ("plan_equivalence",
      [sys.executable, "tools/hlo_analysis.py", "equiv"], {}, 600),
+    # hybrid-mesh parity (ISSUE 19): 2-slice simulated-DCN step vs
+    # single-slice, bitwise via the differential oracle, with the
+    # predicted wire bytes per link class (ICI vs DCN) — the bench
+    # artifact for the hierarchical all-reduce decomposition and
+    # cross-replica weight-update sharding
+    ("hybrid_parity",
+     [sys.executable, "tools/hlo_analysis.py", "hybrid"], {}, 900),
     # chaos matrix (ISSUE 12): the elastic-service fault catalog (worker
     # kill mid-pass, kill-during-checkpoint, master death, heartbeat
     # stall, corrupt checkpoint) x 2 seeds, every cell's recovery

@@ -691,10 +691,16 @@ def analyze(mode: str, args) -> dict:
 
 
 def run_equiv(args) -> None:
-    """The 11-mode plan-equivalence sweep: bespoke wiring vs logical-
-    axis declaration, one JSON line per mode plus a summary line.
-    Desc-only (virtual devices, nothing compiles) — safe to run in the
-    evidence daemon's queue without a live chip."""
+    """The 11-mode plan-equivalence sweep: the live rule-driven plan vs
+    the archived output of the deleted bespoke wiring, one JSON line
+    per mode plus a summary line.  Desc-only (virtual devices, nothing
+    compiles) — safe to run in the evidence daemon's queue without a
+    live chip.  Exits 1 on any DIVERGED entry: this is run_tests.sh's
+    fast-tier gate against the partitioner collapse regressing.
+
+    --capture-golden re-archives the CURRENT plans as
+    parallel/mode_plans_golden.json — only after a PROVEN sweep, so the
+    baseline can never be overwritten by a diverged state."""
     from paddle_tpu.analysis import equivalence as eqv
     from paddle_tpu.parallel import modes as pmodes
 
@@ -706,9 +712,39 @@ def run_equiv(args) -> None:
         rec["analysis"] = "plan_equivalence"
         proven += rec["verdict"] == "PROVEN"
         print(json.dumps(rec), flush=True)
+    diverged = len(names) - proven
     print(json.dumps({"analysis": "plan_equivalence_summary",
                       "modes": len(names), "proven": proven,
-                      "diverged": len(names) - proven}), flush=True)
+                      "diverged": diverged}), flush=True)
+    if getattr(args, "capture_golden", False):
+        if diverged or args.submode:
+            print(json.dumps({
+                "analysis": "plan_equivalence_capture",
+                "error": "refusing to re-archive golden plans from a "
+                         "diverged or partial sweep"}), flush=True)
+            sys.exit(1)
+        import paddle_tpu.parallel as _parallel
+
+        path = os.path.join(os.path.dirname(_parallel.__file__),
+                            "mode_plans_golden.json")
+        eqv.capture_golden_mode_plans(path)
+        print(json.dumps({"analysis": "plan_equivalence_capture",
+                          "path": path}), flush=True)
+    if diverged:
+        sys.exit(1)
+
+
+def run_hybrid(args) -> None:
+    """The 2-slice simulated-DCN parity capture: bitwise differential
+    run (flat dp=8 vs dcn_dp=2 x dp=4 with weight-update sharding) plus
+    predicted wire bytes per link class — the ISSUE 19 bench artifact.
+    Executes real jitted steps on 8 virtual CPU devices."""
+    from paddle_tpu.analysis import equivalence as eqv
+
+    rec = eqv.hybrid_parity_report()
+    print(json.dumps(rec), flush=True)
+    if rec["verdict"] != "PROVEN":
+        sys.exit(1)
 
 
 def analyze_roofline(args) -> None:
@@ -726,7 +762,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
                     choices=["bytes", "collectives", "peak", "roofline",
-                             "comm", "equiv", "all"])
+                             "comm", "equiv", "hybrid", "all"])
     ap.add_argument("--child", default=None)
     ap.add_argument("--mode", dest="submode", default=None)
     ap.add_argument("--bs", type=int, default=32)
@@ -739,6 +775,11 @@ def main():
     ap.add_argument("--tpu", action="store_true",
                     help="bytes mode: use the environment's accelerator "
                          "instead of defaulting to cpu")
+    ap.add_argument("--capture-golden", action="store_true",
+                    dest="capture_golden",
+                    help="equiv mode: after a fully PROVEN sweep, "
+                         "re-archive the live plans as "
+                         "parallel/mode_plans_golden.json")
     args = ap.parse_args()
 
     if args.child:
@@ -763,6 +804,9 @@ def main():
         return
     if args.what == "equiv":
         run_equiv(args)
+        return
+    if args.what == "hybrid":
+        run_hybrid(args)
         return
     if args.what in ("bytes", "all"):
         for fuse in ((False, True) if args.what == "all"

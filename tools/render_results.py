@@ -61,8 +61,8 @@ def main():
             ok_rows.append((utc, name, r))
 
     print("| capture | metric | value | unit | vs baseline | mfu "
-          "| p50/p99 ms | accept | comm | attribution |")
-    print("|---|---|---|---|---|---|---|---|---|---|")
+          "| p50/p99 ms | accept | comm | attribution | modes |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
     for utc, name, r in ok_rows:
         # serving rows (tools/serve_bench.py) carry request-latency
         # percentiles beside the throughput headline
@@ -99,11 +99,23 @@ def main():
                     else f"top {r['top_op']}")
         elif "raw_rank" in r:
             atxt = f"raw rank {r['raw_rank']} -> {r.get('value')}"
+        # plan-equivalence rows (tools/hlo_analysis.py equiv): the
+        # partitioner-collapse gate's modes-PROVEN score; hybrid-parity
+        # rows show their bitwise verdict + per-link-class wire bytes
+        mtxt = ""
+        if r.get("analysis") == "plan_equivalence_summary":
+            mtxt = f"{r.get('proven', 0)}/{r.get('modes', 0)} PROVEN"
+        elif r.get("analysis") == "hybrid_parity":
+            lb = ((r.get("comm") or {}).get("hybrid") or {}).get(
+                "link_bytes") or {}
+            mtxt = (f"{r.get('verdict', '')} bitwise; "
+                    f"ici {lb.get('ici', '?')} B / "
+                    f"dcn {lb.get('dcn', '?')} B")
         print(f"| {name} | {r.get('metric', r.get('mode', ''))} "
               f"| {r.get('value')} "
               f"| {r.get('unit', '')} | {r.get('vs_baseline', '')} "
               f"| {r.get('mfu', '')} | {ptxt} | {acctxt} | {ctxt} "
-              f"| {atxt} |")
+              f"| {atxt} | {mtxt} |")
     if failed:
         print("\nFailed/empty captures:")
         for name, err in failed:

@@ -148,6 +148,47 @@ def _check_partition_spec(root, dirpath, filenames, findings):
             pass
 
 
+# the mode-dispatch confinement guard (ISSUE 19): after the partitioner
+# collapse, parallelism modes exist ONLY as declarative records in
+# parallel/modes.py — a mode-name string literal anywhere else in
+# paddle_tpu/ is the start of a bespoke dispatch branch regrowing.
+# Short names shared with mesh axes ("dp", "pp", "sp") are omitted:
+# they are legitimate axis names everywhere; the compound names below
+# have no meaning outside the mode catalog.
+_MODE_DISPATCH_RE = re.compile(
+    r"[\"'](?:dp_mp|fsdp|sp_ring|sp_ulysses|ep_dp|lm_dp_sp|pp_dp|"
+    r"emb_mp|host_emb)[\"']")
+_MODE_DISPATCH_DIR = "paddle_tpu"
+_MODE_DISPATCH_OK = os.path.join("paddle_tpu", "parallel", "modes.py")
+
+
+def _check_mode_dispatch(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    if not (rel_dir == _MODE_DISPATCH_DIR
+            or rel_dir.startswith(_MODE_DISPATCH_DIR + os.sep)):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel == _MODE_DISPATCH_OK:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _MODE_DISPATCH_RE.search(line):
+                        findings.append(
+                            f"mode-name string dispatch outside the "
+                            f"mode catalog: {rel}:{i} (parallelism "
+                            f"modes are declarative records in parallel/"
+                            f"modes.py; any program shards by declaring "
+                            f"axis rules, never by branching on a mode "
+                            f"name)")
+        except OSError:
+            pass
+
+
 # the page-table mutation guard: assignment (plain or augmented) through
 # a `.page_table[...]` subscript anywhere under paddle_tpu/ outside the
 # allocator module — reads don't match (the `=` must follow the `]`).
@@ -487,6 +528,7 @@ def lint(root: str):
             continue
         _check_compiler_params(root, dirpath, filenames, findings)
         _check_partition_spec(root, dirpath, filenames, findings)
+        _check_mode_dispatch(root, dirpath, filenames, findings)
         _check_page_table(root, dirpath, filenames, findings)
         _check_perf_counter(root, dirpath, filenames, findings)
         _check_knob_env(root, dirpath, filenames, findings)
