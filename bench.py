@@ -624,6 +624,91 @@ def bench_lstm_train(warmup, iters):
     }
 
 
+def bench_step_loop(warmup, iters):
+    """Fused K-step dispatch sweep (ISSUE 20, framework/step_loop.py):
+    the Momentum MLP stepped K∈{1,2,4,8} steps per device dispatch via
+    the PADDLE_TPU_STEPS_PER_DISPATCH opt-in — the production env
+    path, so the sweep times exactly what a user enabling the loop
+    gets.  One timed iteration = one dispatch of K steps; steps/s =
+    K/dt, so every row reports equal work.  The headline is the
+    best fused K's measured steps/s speedup over K=1, with
+    `cost.step_loop_cost`'s predicted speedup and the
+    predicted-vs-measured amortization error published per K (the
+    price model is only evidence if its error is on the record).
+    The model is deliberately tiny (bs8 16->32->1): per-dispatch
+    overhead dominates, which is the regime the loop exists for.
+    Opt-in via BENCH_MODEL=step_loop.  Overrides: BENCH_BS,
+    BENCH_STEP_LOOP_KS (comma list)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import cost as _cost
+
+    bs = int(os.environ.get("BENCH_BS", "8"))
+    ks = tuple(int(k) for k in os.environ.get(
+        "BENCH_STEP_LOOP_KS", "1,2,4,8").split(","))
+    assert ks[0] == 1, "the sweep needs the K=1 anchor first"
+
+    x = fluid.layers.data(name="x", shape=[16])
+    y = fluid.layers.data(name="y", shape=[1])
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Momentum(learning_rate=0.01,
+                             momentum=0.9).minimize(loss)
+    main_prog = fluid.default_main_program()
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    chip = _cost.detect_chip()
+
+    rng = np.random.RandomState(0)
+    per_step = [{"x": rng.randn(bs, 16).astype(np.float32),
+                 "y": rng.randn(bs, 1).astype(np.float32)}
+                for _ in range(max(ks))]
+
+    rows, steps_per_s = [], {}
+    for k in ks:
+        feed = (per_step[0] if k == 1 else
+                {n: np.stack([f[n] for f in per_step[:k]])
+                 for n in ("x", "y")})
+        staged = _stage(place, feed)
+        os.environ["PADDLE_TPU_STEPS_PER_DISPATCH"] = str(k)
+        try:
+            dt = _timed_loop(exe, staged, loss, warmup, iters,
+                             program=main_prog)
+        finally:
+            os.environ.pop("PADDLE_TPU_STEPS_PER_DISPATCH", None)
+        steps_per_s[k] = k / dt
+        pred_rep = _cost.step_loop_cost(main_prog, k, batch_size=bs,
+                                        chip=chip)
+        rows.append((k, dt, pred_rep["predicted_speedup"]))
+        _mark(f"step_loop k={k}: {steps_per_s[k]:.0f} steps/s")
+
+    extras = []
+    for k, dt, pred_speedup in rows:
+        measured = steps_per_s[k] / steps_per_s[1]
+        err_pct = (abs(pred_speedup - measured) / measured) * 100.0
+        extras.append({
+            "metric": f"step_loop_steps_per_s_k{k}",
+            "value": round(steps_per_s[k], 1),
+            "unit": "steps/s",
+            "vs_baseline": round(measured, 3),
+            "predicted_speedup": round(pred_speedup, 3),
+            "prediction_error_pct": round(err_pct, 1),
+        })
+    best_k, best = max(((k, v) for k, v in steps_per_s.items() if k > 1),
+                       key=lambda kv: kv[1])
+    return {
+        "metric": "step_loop_fused_speedup",
+        "value": round(best / steps_per_s[1], 2),
+        "unit": "x",
+        "vs_baseline": round(best / steps_per_s[1], 2),
+        "note": (f"best fused K={best_k} vs K=1 sequential dispatch, "
+                 f"chip model {chip}"),
+        "extra_metrics": extras,
+    }
+
+
 def main():
     _env_layout()  # fail fast on a bad BENCH_LAYOUT, before backend init
 
@@ -683,6 +768,9 @@ def main():
         return
     if model == "gpt_gen":
         finish(bench_gpt_generate(warmup, max(1, iters // 4)))
+        return
+    if model == "step_loop":
+        finish(bench_step_loop(warmup, iters))
         return
     if model != "all":
         finish(runners[model](warmup, iters))

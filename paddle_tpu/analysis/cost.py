@@ -56,6 +56,17 @@ CHIP_SPECS: Dict[str, dict] = {
 _DTYPE_RATE = {"bfloat16": 1.0, "float16": 1.0,
                "float32": 0.5, "float64": 0.0625}
 
+# Per-DISPATCH host overhead floor (seconds): tracing-free jit call +
+# transfer setup + fetch sync — what one Executor.run pays beyond the
+# device step itself.  Defaults are deliberately coarse priors; when
+# the PR 16 calibration store holds measured per-op affine intercepts
+# for the chip, `step_loop_cost` prices with their SUM instead (that
+# sum is exactly what `calibrated_step_time_s` adds once per dispatch).
+DEFAULT_DISPATCH_OVERHEAD_S: Dict[str, float] = {
+    "v4": 8e-5, "v5e": 8e-5, "v5p": 8e-5, "v6e": 8e-5,
+    "cpu-host": 1.5e-4,
+}
+
 
 def chip_spec(name: Optional[str] = None) -> dict:
     """Spec by name, defaulting to $PADDLE_TPU_CHIP then v5e."""
@@ -208,7 +219,7 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
     by_type: Dict[str, dict] = {}
     flops_by_dtype: Dict[str, int] = {}
     tot_flops = tot_bytes = tot_coll = 0
-    per_op_time = cal_time = 0.0
+    per_op_time = cal_time = overhead_total = 0.0
     applied = 0
     unmodeled = 0
     for op in block.ops:
@@ -240,6 +251,7 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
             if ent:
                 # affine: the fitted overhead_s charges the per-op
                 # dispatch floor a ratio cannot see (calibration.py)
+                overhead_total += float(ent.get("overhead_s") or 0.0)
                 cal_time += (float(ent["factor"]) * t_op
                              + float(ent.get("overhead_s") or 0.0))
                 applied += 1
@@ -277,7 +289,11 @@ def program_cost(program, batch_size: int = 64, block_id: int = 0,
         report["calibrated_step_time_s"] = cal_time
         report["calibration"] = {"chip": spec["chip"],
                                  "factors_applied": int(applied),
-                                 "factors_known": len(factors)}
+                                 "factors_known": len(factors),
+                                 # the per-dispatch share of the affine
+                                 # fits: what one fused K-step loop pays
+                                 # ONCE instead of K times (step_loop_cost)
+                                 "overhead_s_total": overhead_total}
     return report
 
 
@@ -312,6 +328,62 @@ def roofline_with_comm(report: dict, comm: dict,
     out["mfu_ceiling"] = (t_compute / step) if step else 0.0
     out["comm_per_kind"] = comm.get("per_kind", {})
     return out
+
+
+def step_loop_cost(program, k: int, batch_size: int = 64,
+                   block_id: int = 0, chip: Optional[str] = None,
+                   calibration: Optional[bool] = None,
+                   overhead_s: Optional[float] = None) -> dict:
+    """Price a fused K-step dispatch (framework/step_loop.py) against K
+    sequential dispatches of the same program:
+
+        fused      = K * step + 1 * overhead_s
+        sequential = K * (step + overhead_s)
+
+    `step` is the pure device step (calibrated when the store has
+    factors for this chip — with the affine intercepts REMOVED, since
+    they are the per-dispatch share being amortized); `overhead_s` is
+    the per-dispatch host floor (explicit arg > calibration intercept
+    sum > DEFAULT_DISPATCH_OVERHEAD_S for the chip).  The predicted
+    speedup `sequential / fused` is the rankable quantity `paddle tune
+    step_loop` prices K candidates with, and the bench `step_loop`
+    sweep publishes predicted-vs-measured error against."""
+    if int(k) < 1:
+        raise ValueError(f"steps_per_dispatch k={k} must be >= 1")
+    k = int(k)
+    rep = program_cost(program, batch_size, block_id, chip, calibration)
+    cal = rep.get("calibration") or {}
+    if overhead_s is None:
+        overhead_s = cal.get("overhead_s_total")
+    if not overhead_s:
+        overhead_s = DEFAULT_DISPATCH_OVERHEAD_S.get(rep["chip"], 8e-5)
+    overhead_s = float(overhead_s)
+    if "calibrated_step_time_s" in rep:
+        step = max(rep["calibrated_step_time_s"]
+                   - float(cal.get("overhead_s_total") or 0.0), 0.0)
+        step_source = "calibrated"
+    else:
+        step = rep["predicted_step_time_s"]
+        step_source = "roofline"
+    fused = k * step + overhead_s
+    sequential = k * (step + overhead_s)
+    return {
+        "analysis": "step_loop_cost",
+        "chip": rep["chip"],
+        "batch_size": int(batch_size),
+        "k": k,
+        "step_time_s": step,
+        "step_source": step_source,
+        "overhead_s": overhead_s,
+        "fused_time_s": fused,
+        "sequential_time_s": sequential,
+        "predicted_speedup": (sequential / fused) if fused else 1.0,
+        "steps_per_s_fused": (k / fused) if fused else 0.0,
+        "steps_per_s_sequential": (k / sequential) if sequential else 0.0,
+        # overhead left per step after amortization — the diminishing
+        # return that caps useful K
+        "amortized_overhead_s": overhead_s / k,
+    }
 
 
 def render(report: dict, top: int = 8) -> str:

@@ -1231,3 +1231,133 @@ def hybrid_parity_report(batch_size: int = 8) -> dict:
         "velocity_specs_hybrid": velocity_specs,
         "comm": {"single": comm_a, "hybrid": comm_b},
     }
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: fused K-step dispatch vs K sequential dispatches
+
+
+def _loop_models():
+    """The two loop-parity obligations: a Momentum-MLP (hidden layer +
+    velocity state, the smallest real training step) and the standing
+    small decoder LM (attention, layernorm, Adam moments — the stateful
+    stochastic program family step_loop must not perturb)."""
+    from ..framework import unique_name
+    from ..framework.core import Program, program_guard
+
+    def mlp():
+        import paddle_tpu as fluid
+
+        x = fluid.layers.data(name="x", shape=[16])
+        y = fluid.layers.data(name="y", shape=[1])
+        h = fluid.layers.fc(x, size=32, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(loss)
+        return loss.name, ["x", "y"]
+
+    def small_lm():
+        from ..models import standing
+
+        feed, fetches, _bs = standing.build_small_lm()
+        return _name_of(fetches[0]), sorted(feed)
+
+    for kind, build in (("mlp", mlp), ("small_lm", small_lm)):
+        main, startup = Program(), Program()
+        with unique_name.guard(), program_guard(main, startup):
+            loss_name, feed_names = build()
+        yield kind, main, startup, loss_name, feed_names
+
+
+def _name_of(f):
+    return f if isinstance(f, str) else f.name
+
+
+def loop_parity_report(ks: Sequence[int] = (1, 2, 4, 8),
+                       batch_size: int = 4) -> dict:
+    """K-step fused dispatch (`Executor.run(steps_per_dispatch=K)`,
+    framework/step_loop.py) vs K sequential `run()` calls, judged at
+    BITWISE tolerance on every per-step fetch AND every written-back
+    state value (params, velocities, Adam moments).
+
+    Both sides start from an identical copy of the startup-initialized
+    state and see the same K deterministic feed batches (`build_feeds`
+    seeded per step); the sequential side pins `rng_step=i`, the fused
+    side `rng_step=0` with the on-device `fold_in(base, step0+i)`
+    stream — so agreement proves the fused loop IS K steps, RNG
+    included, not merely close.  The run_tests.sh `loop` gate consumes
+    the verdict (PROVEN required)."""
+    from ..analysis import dataflow
+    from ..framework.executor import Executor
+    from ..framework.place import CPUPlace
+    from ..framework.scope import Scope
+
+    cases = []
+    for kind, main, startup, loss_name, feed_names in _loop_models():
+        block = main.global_block()
+        ext, rw, written = dataflow.state_classes(block, feed_names)
+        exe = Executor(CPUPlace())
+        for k in ks:
+            k = int(k)
+            sa, sb = Scope(), Scope()
+            exe.run(startup, scope=sa, verify=False)
+            for n in set(ext) | set(rw):
+                v = sa.find(n)
+                if v is not None:
+                    sb.set(n, np.array(np.asarray(v)))
+            feeds = [build_feeds(main, feed_names, batch_size, seed=i)
+                     for i in range(k)]
+            # K=1 is the identity path (no stacking in, none out): its
+            # "parity" is plain run-to-run determinism
+            stacked = (feeds[0] if k == 1 else
+                       {n: np.stack([f[n] for f in feeds])
+                        for n in feed_names})
+            seq = [np.asarray(exe.run(main, feed=feeds[i],
+                                      fetch_list=[loss_name], scope=sb,
+                                      rng_step=i, verify=False)[0])
+                   for i in range(k)]
+            fused = np.asarray(exe.run(
+                main, feed=stacked, fetch_list=[loss_name], scope=sa,
+                rng_step=0, verify=False, steps_per_dispatch=k)[0])
+            findings = []
+            if k > 1 and tuple(fused.shape[:1]) != (k,):
+                findings.append(
+                    f"fetch {loss_name!r} not stacked (K, ...): "
+                    f"{fused.shape}")
+            for i in range(k):
+                a = fused[i] if k > 1 else fused
+                if a.shape != seq[i].shape or not np.array_equal(a, seq[i]):
+                    findings.append(
+                        f"fetch {loss_name!r} step {i} diverged: "
+                        f"fused={a!r} sequential={seq[i]!r}")
+            for n in written:
+                a, b = np.asarray(sa.find(n)), np.asarray(sb.find(n))
+                if a.shape != b.shape:
+                    findings.append(
+                        f"written state {n!r} shape diverged: "
+                        f"{a.shape} vs {b.shape}")
+                elif not np.array_equal(a, b):
+                    d = np.max(np.abs(a.astype(np.float64)
+                                      - b.astype(np.float64)))
+                    findings.append(
+                        f"written state {n!r} diverged after {k} steps: "
+                        f"max|a-b|={d:.3e}")
+            cases.append({
+                "model": kind, "k": k,
+                "fetches": [loss_name],
+                "written_state": len(written),
+                "bitwise": not findings,
+                "findings": findings,
+            })
+    all_ok = all(c["bitwise"] for c in cases)
+    return {
+        "analysis": "loop_parity",
+        "ks": [int(k) for k in ks],
+        "batch_size": int(batch_size),
+        "models": sorted({c["model"] for c in cases}),
+        "cases": cases,
+        "bitwise": all_ok,
+        "verdict": "PROVEN" if all_ok else "DIVERGED",
+        "findings": [f for c in cases for f in c["findings"]],
+    }

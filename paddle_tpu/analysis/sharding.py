@@ -1248,7 +1248,9 @@ def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
     sharding one dim over ``("dcn_dp", "dp")``) is priced as GSPMD's
     hierarchical all-reduce decomposition: per-slice ICI reduce-scatter
     → DCN all-reduce of the 1/n_ici shard → per-slice ICI all-gather,
-    so the slow DCN link carries only 1/n_ici of the buffer."""
+    so the slow DCN link carries only 1/n_ici of the buffer.  Hybrid
+    all-gathers decompose the same way (ISSUE 20): DCN all-gather of the
+    1/n_ici co-shard, then a per-slice ICI all-gather."""
     from .cost import chip_spec
 
     spec = chip_spec(chip)
@@ -1285,6 +1287,19 @@ def comm_report(analysis: ShardingAnalysis, chip: Optional[str] = None,
                 "dcn_all_reduce_bytes": int(w_dcn),
                 "ici_all_gather_bytes": int(
                     wire_factor("all-gather", n_ici) * c.bytes),
+            }
+        elif n_dcn > 1 and n_ici > 1 and c.kind == "all-gather":
+            # hierarchical hybrid all-gather: DCN all-gather of the
+            # corresponding 1/n_ici co-shards first (each device then
+            # holds its slice-local 1/n_ici chunk of the full buffer),
+            # then a per-slice ICI all-gather completes the output — the
+            # slow DCN link carries only 1/n_ici of the buffer instead
+            # of the full gather a flat pricing would charge it
+            w_dcn = wire_factor("all-gather", n_dcn) * (c.bytes // n_ici)
+            w_ici = wire_factor("all-gather", n_ici) * c.bytes
+            decomposed = {
+                "dcn_all_gather_bytes": int(w_dcn),
+                "ici_all_gather_bytes": int(w_ici),
             }
         elif n_dcn > 1:
             w_ici = 0.0

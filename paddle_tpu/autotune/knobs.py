@@ -222,6 +222,25 @@ def speculation_k(default: int = 4) -> int:
     return int(v or default)
 
 
+def steps_per_dispatch(default: int = 1, store: bool = True) -> int:
+    """Fused K-step dispatch depth (framework/step_loop.py): how many
+    training steps one Executor dispatch scans over.  Trial override >
+    PADDLE_TPU_STEPS_PER_DISPATCH (validated positive int) > stored
+    ``step_loop`` winner > `default`.
+
+    ``store=False`` skips the winner lookup — Executor.run's default
+    path uses it, because K>1 changes run()'s return contract (stacked
+    fetches) and a persisted winner must never silently reshape a
+    caller's results; only the explicit arg/env opt-ins may fuse."""
+    v = _trial_value("step_loop.steps_per_dispatch")
+    if v is None:
+        v = _env_int("PADDLE_TPU_STEPS_PER_DISPATCH",
+                     "fused steps per dispatch")
+    if v is None and store:
+        v = _site_winner("step_loop", {}).get("steps_per_dispatch")
+    return int(v or default)
+
+
 def spec_draft_layers(default: int) -> int:
     """Draft-tower depth for self-speculation (the target's first N
     blocks; serving/speculative.py).  Trial override >

@@ -127,7 +127,13 @@ def price(workload, candidate, chip: Optional[str] = None,
                     candidate, folded["predicted_step_time_s"],
                     int(c.get("peak_bytes", c["bytes"])),
                     bound=folded["predicted_bound"])
-            step = max(t_compute, t_memory)
+            # step-loop-style workloads price a per-dispatch host
+            # overhead the candidate amortizes (analysis/cost.py
+            # DEFAULT_DISPATCH_OVERHEAD_S): additive on top of the
+            # roofline max, since the host floor overlaps with neither
+            # compute nor HBM traffic
+            step = (max(t_compute, t_memory)
+                    + float(c.get("overhead_s") or 0.0))
             return PricedCandidate(
                 candidate, step, int(c.get("peak_bytes", c["bytes"])),
                 bound="compute" if t_compute >= t_memory else "memory")

@@ -192,6 +192,18 @@ def spec_decode_space(n_layers: int = 4,
     ])
 
 
+def step_loop_space(ks: Sequence[int] = (1, 2, 4, 8)) -> SearchSpace:
+    """Fused K-step dispatch axis (framework/step_loop.py): how many
+    training steps one device dispatch runs via `lax.scan`.  K=1 first
+    — the plain dispatch-per-step path is the default an un-tuned
+    `Executor.run` takes.  The winner lands under the
+    ("step_loop", {}) site that ``knobs.steps_per_dispatch`` resolves
+    for callers that opt in with ``store=True``."""
+    return SearchSpace([
+        Choice("step_loop.steps_per_dispatch", tuple(ks)),
+    ])
+
+
 def mlp_depth_space(depths: Sequence[int] = (16, 4, 1)) -> SearchSpace:
     """Depth-vs-width axis at ~constant hidden FLOPs (depth * width^2
     fixed): the op-COUNT workload.  The deepest stack is the default

@@ -662,6 +662,139 @@ class SpecDecodeWorkload:
         return _ServeRunner(eng, prompts, self.max_new)
 
 
+class _StepLoopRunner:
+    """One step() = `dispatches` Executor.run calls totalling the same
+    number of training steps for every candidate — K amortizes the
+    per-dispatch overhead, it never changes the math.  run() is called
+    WITHOUT the steps_per_dispatch kwarg: the knob resolves it through
+    the ACTIVE TRIAL OVERRIDE (the production path), and a K mismatch
+    fails loudly in step_loop.check_stacked instead of silently timing
+    the wrong shape — the A/B proves the routing, not just the loop."""
+
+    def __init__(self, exe, program, scope, feed, loss_name, dispatches):
+        self._exe, self._program = exe, program
+        self._scope, self._feed = scope, feed
+        self._loss, self._dispatches = loss_name, int(dispatches)
+        self._last = None
+
+    def step(self):
+        for _ in range(self._dispatches):
+            self._last = self._exe.run(
+                self._program, feed=self._feed,
+                fetch_list=[self._loss], scope=self._scope)
+
+    def barrier(self):
+        if self._last is not None:
+            np.asarray(self._last[0]).ravel()[:1]
+
+    def close(self):
+        pass
+
+
+class StepLoopWorkload:
+    """Fused K-step dispatch (framework/step_loop.py) over the Momentum
+    MLP: every candidate runs the SAME `total_steps` training steps,
+    K=1 as `total_steps` dispatches, K=8 as `total_steps/8` — so the
+    measured per-step() time isolates exactly what the axis changes,
+    the number of host->device dispatch round-trips.  The analytic
+    prior prices this as `(T/K) * overhead_s` on top of the (tied)
+    roofline via the additive `overhead_s` key, mirroring
+    `cost.step_loop_cost`'s `K*step + overhead` fused model.  The
+    winner persists under the ("step_loop", {}) site that
+    ``knobs.steps_per_dispatch(store=True)`` resolves — never the
+    executor's own default path (store=False there: a stored K would
+    silently change `run()`'s return shape)."""
+
+    kind = "loop"
+    name = "step_loop"
+
+    def __init__(self, batch_size: int = 4, total_steps: int = 8):
+        self.batch_size = int(batch_size)
+        self.total_steps = int(total_steps)
+        self._built = None
+        self._reports: Dict[str, dict] = {}
+
+    def site(self) -> dict:
+        return {"workload": self.name, "model": "mlp_momentum",
+                "batch_size": self.batch_size,
+                "total_steps": self.total_steps}
+
+    def space(self) -> _space.SearchSpace:
+        return _space.step_loop_space(
+            ks=[k for k in (1, 2, 4, 8) if k <= self.total_steps])
+
+    def kernel_sites(self) -> Tuple:
+        return (("step_loop", {},
+                 {"steps_per_dispatch": "step_loop.steps_per_dispatch"}),)
+
+    def program_for(self, candidate):
+        return None  # priced analytically; overhead_s differentiates
+
+    def _program(self):
+        if self._built is None:
+            import paddle_tpu as fluid
+            from ..framework import unique_name
+            from ..framework.core import Program, program_guard
+
+            main, startup = Program(), Program()
+            with unique_name.guard(), program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[16])
+                y = fluid.layers.data(name="y", shape=[1])
+                h = fluid.layers.fc(x, size=32, act="relu")
+                pred = fluid.layers.fc(h, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.Momentum(
+                    learning_rate=0.01, momentum=0.9).minimize(loss)
+            self._built = (main, startup, loss.name, ["x", "y"])
+        return self._built
+
+    def analytic_cost(self, candidate, spec) -> dict:
+        from ..analysis import cost as _c
+
+        k = int(candidate.get("step_loop.steps_per_dispatch", 1))
+        chip = spec["chip"]
+        rep = self._reports.get(chip)
+        if rep is None:
+            rep = _c.program_cost(self._program()[0],
+                                  batch_size=self.batch_size, chip=chip)
+            self._reports[chip] = rep
+        T = self.total_steps
+        overhead = _c.DEFAULT_DISPATCH_OVERHEAD_S.get(chip, 8e-5)
+        return {"flops": T * rep["total_flops"],
+                "bytes": T * rep["hbm_bytes"],
+                "overhead_s": (T // max(k, 1)) * overhead}
+
+    def feasible(self, candidate, spec):
+        k = int(candidate.get("step_loop.steps_per_dispatch", 1))
+        if k < 1:
+            return False, f"steps_per_dispatch={k} must be >= 1"
+        if self.total_steps % k:
+            return False, (f"total_steps={self.total_steps} not "
+                           f"divisible by steps_per_dispatch={k} — "
+                           f"candidates would run unequal work")
+        return True, ""
+
+    def build_runner(self, candidate) -> _StepLoopRunner:
+        import paddle_tpu as fluid
+        from ..analysis.equivalence import build_feeds
+        from ..framework.scope import Scope
+
+        k = int(candidate.get("step_loop.steps_per_dispatch", 1))
+        main, startup, loss_name, feed_names = self._program()
+        exe = fluid.Executor(fluid.default_place())
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        feeds = [build_feeds(main, feed_names, self.batch_size, seed=i)
+                 for i in range(k)]
+        # K=1 is the identity path: plain per-step feeds, no K dim
+        feed = (feeds[0] if k == 1 else
+                {n: np.stack([f[n] for f in feeds])
+                 for n in feed_names})
+        return _StepLoopRunner(exe, main, scope, feed, loss_name,
+                               self.total_steps // k)
+
+
 # ---------------------------------------------------------------------------
 # saved-model workloads (`paddle tune <dir>`)
 
@@ -910,6 +1043,7 @@ WORKLOADS: Dict[str, Callable[[], object]] = {
     "lstm": lambda: ProgramWorkload("lstm", _build_lstm, _lstm_space),
     "mlp_depth": MlpDepthWorkload,
     "mesh_layout": MeshLayoutWorkload,
+    "step_loop": StepLoopWorkload,
 }
 
 

@@ -54,39 +54,103 @@ class DataFeeder:
                 out[var.name] = arr.astype(np_dtype(var.dtype), copy=False)
         return out
 
+    def feed_stacked(self, minibatches: List[List[tuple]]
+                     ) -> Dict[str, object]:
+        """K minibatches → one leading-stacked (K, batch, ...) feed
+        block, the input contract of the fused K-step dispatch
+        (``Executor.run(steps_per_dispatch=K)``,
+        framework/step_loop.py).  Every minibatch must convert to the
+        same per-step shapes — bucketed LoD padding can differ across
+        steps, so pad ragged sequence batches identically (or keep
+        lod feeds on the K=1 path)."""
+        if not minibatches:
+            raise ValueError("feed_stacked needs at least one minibatch")
+        feeds = [self.feed(mb) for mb in minibatches]
+        out = {}
+        for k in feeds[0]:
+            cols = [np.asarray(f[k]) for f in feeds]
+            shapes = {c.shape for c in cols}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"feed {k!r} shapes differ across the {len(feeds)} "
+                    f"stacked steps ({sorted(shapes)}) — a scanned loop "
+                    f"needs one static per-step shape")
+            out[k] = np.stack(cols)
+        return out
+
 
 class DeviceFeeder:
-    """Wraps a batched reader: converts + device_puts batches ahead of use."""
+    """Wraps a batched reader: converts + device_puts batches ahead of
+    use.  With ``steps=K`` each yielded item is a leading-stacked
+    (K, batch, ...) block ready for
+    ``Executor.run(steps_per_dispatch=K)`` — a ragged final block keeps
+    its short leading dim (run it with steps_per_dispatch=m).  Producer
+    exceptions re-raise in the consumer; abandoning the iterator stops
+    the thread (same contract as ``reader.decorator.prefetch``)."""
 
-    def __init__(self, feeder: DataFeeder, reader, device=None, depth: int = 2):
+    def __init__(self, feeder: DataFeeder, reader, device=None,
+                 depth: int = 2, steps: int = 1):
+        if steps < 1:
+            raise ValueError(f"steps={steps} must be >= 1")
         self.feeder = feeder
         self.reader = reader
         self.depth = depth
         self.device = device
+        self.steps = int(steps)
 
     def __iter__(self):
         import jax
 
         dev = self.device or (
             self.feeder.place.jax_device() if self.feeder.place else None)
-        end = object()
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(msg):
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _emit(group):
+            feed = (self.feeder.feed(group[0]) if self.steps == 1
+                    else self.feeder.feed_stacked(group))
+            return _put(("block", {k: jax.device_put(v, dev)
+                                   for k, v in feed.items()}))
 
         def producer():
             try:
+                group = []
                 for minibatch in self.reader():
-                    feed = self.feeder.feed(minibatch)
-                    staged = {
-                        k: jax.device_put(v, dev) for k, v in feed.items()
-                    }
-                    q.put(staged)
-            finally:
-                q.put(end)
+                    group.append(minibatch)
+                    if len(group) == self.steps:
+                        if not _emit(group):
+                            return
+                        group = []
+                if group and not _emit(group):
+                    return
+                _put(("end", None))
+            except BaseException as e:  # noqa: BLE001 — relayed whole
+                _put(("error", e))
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="paddle-tpu-device-feeder")
         t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                return
-            yield item
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "end":
+                    return
+                if kind == "error":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)

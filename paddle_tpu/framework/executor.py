@@ -213,6 +213,12 @@ class Executor:
         self._step = 0
         # subclasses running sharded over a mesh bypass single-device pinning
         self._pin_device = True
+        # sharded subclasses need the step output pytree to match their
+        # out_shardings exactly (no `if in env` guard)
+        self._strict_state = False
+        # loop-safety verdicts (framework/step_loop.safety_report), keyed
+        # like _verified so only a desc mutation re-runs the scan
+        self._loop_safety: Dict[tuple, dict] = {}
         # FLAGS_check_nan_inf analog: per-step non-finite scan of outputs
         self.check_nan_inf = False
         # programs already verified (analysis/verifier.py), keyed like the
@@ -336,6 +342,8 @@ class Executor:
         block_id: int = 0,
         verify: Optional[bool] = None,
         rng_step: Optional[int] = None,
+        steps_per_dispatch: Optional[int] = None,
+        fetch_every: str = "all",
     ):
         """`verify`: run the static program verifier (analysis/verifier.py)
         before execution and raise VerificationError on error findings.
@@ -347,8 +355,33 @@ class Executor:
         translation-validation differential oracle
         (analysis/equivalence.py) runs an original/rewritten program
         pair with rng_step=0 so both sides draw the same stochastic
-        stream regardless of executor history."""
+        stream regardless of executor history.
+
+        `steps_per_dispatch`: run K training steps in ONE fused dispatch
+        (framework/step_loop.py): every feed must be leading-stacked
+        `(K, ...)` — one slice per step — and fetches come back stacked
+        `(K, ...)` (`fetch_every="all"`) or last-only ("last"); written
+        state is the post-K value, the PRNG stream matches K sequential
+        runs bit-for-bit, and `rng_step` (when given) pins the FIRST
+        step's index.  None defers to PADDLE_TPU_STEPS_PER_DISPATCH
+        (resolved through autotune.knobs; the stored `tune step_loop`
+        winner is deliberately NOT auto-applied here — K changes the
+        run() return shape, so only an explicit opt-in may set it).
+        Loop-unsafe programs (save/load ops, nested control flow) fall
+        back loudly to K sequential dispatches."""
         from .core import default_main_program
+
+        if steps_per_dispatch is None:
+            from ..autotune.knobs import steps_per_dispatch as _k_knob
+
+            steps_per_dispatch = _k_knob(default=1, store=False)
+        k = int(steps_per_dispatch)
+        if k < 1:
+            raise ValueError(f"steps_per_dispatch={k} must be >= 1")
+        if k > 1:
+            return self._run_loop(program, feed, fetch_list, scope,
+                                  return_numpy, block_id, verify, rng_step,
+                                  k, fetch_every)
 
         program = program if program is not None else default_main_program()
         feed = feed or {}
@@ -400,28 +433,7 @@ class Executor:
         # telemetry: the DONATION phase — pinning the donated (rw) and
         # read-only state buffers into device memory before the step
         with _TRC.span("executor.donate", feeds=len(feed)) as sp_don:
-            state_w = {}
-            for n in compiled.rw_state:
-                v = scope.find(n)
-                if v is None:
-                    raise RuntimeError(
-                        f"variable {n!r} used before initialization — run "
-                        f"the startup program first (fluid semantics)"
-                    )
-                state_w[n] = self._pin_host_array(scope, n, v)
-            state_r = {}
-            for n in compiled.external_reads:
-                v = scope.find(n)
-                if v is None:
-                    bvar = block._find_var_recursive(n)
-                    if bvar is not None and bvar.is_data:
-                        raise RuntimeError(
-                            f"data variable {n!r} was not fed — add it to "
-                            f"`feed`"
-                        )
-                    raise RuntimeError(
-                        f"variable {n!r} not initialized in scope")
-                state_r[n] = self._pin_host_array(scope, n, v)
+            state_w, state_r = self._pin_state(compiled, scope, block)
             sp_don.note(donated=len(state_w), reads=len(state_r))
 
         rng = jax.random.fold_in(
@@ -514,6 +526,179 @@ class Executor:
         return [fetches[n] for n in fetch_names]
 
     # ------------------------------------------------------------------
+    def _pin_state(self, compiled, scope, block):
+        """Resolve + device-pin the donated (rw) and read-only state for
+        one dispatch; missing state raises the fluid-semantics errors."""
+        state_w = {}
+        for n in compiled.rw_state:
+            v = scope.find(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} used before initialization — run "
+                    f"the startup program first (fluid semantics)"
+                )
+            state_w[n] = self._pin_host_array(scope, n, v)
+        state_r = {}
+        for n in compiled.external_reads:
+            v = scope.find(n)
+            if v is None:
+                bvar = block._find_var_recursive(n)
+                if bvar is not None and bvar.is_data:
+                    raise RuntimeError(
+                        f"data variable {n!r} was not fed — add it to "
+                        f"`feed`"
+                    )
+                raise RuntimeError(
+                    f"variable {n!r} not initialized in scope")
+            state_r[n] = self._pin_host_array(scope, n, v)
+        return state_w, state_r
+
+    # ------------------------------------------------------------------
+    def _run_loop(self, program, feed, fetch_list, scope, return_numpy,
+                  block_id, verify, rng_step, k, fetch_every):
+        """The fused K-step path of run() (framework/step_loop.py): one
+        XLA dispatch scans the step over leading-stacked feeds with the
+        state carry donated and resident for all K steps.  Loop-unsafe
+        programs degrade loudly to K sequential run() calls with the
+        same stacked-fetch return shape."""
+        from . import step_loop
+        from .core import default_main_program
+
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
+        scope = scope if scope is not None else global_scope()
+        t_run0 = _monotime()
+
+        if verify is None:
+            from ..analysis.verifier import env_verify_enabled
+
+            verify = env_verify_enabled()
+        if verify:
+            self._verify_program(program, block_id, sorted(feed),
+                                 fetch_names)
+
+        skey = (program._cache_token, program._version, block_id)
+        safety = self._loop_safety.get(skey)
+        if safety is None:
+            for old in [s for s in self._loop_safety
+                        if s[0] == program._cache_token
+                        and s[1] != program._version]:
+                del self._loop_safety[old]
+            safety = step_loop.safety_report(program, block_id)
+            self._loop_safety[skey] = safety
+
+        block = program.blocks[block_id]
+        feed_vals = self._prepare_feeds(block, feed, stacked=True)
+        step_loop.check_stacked(feed_vals, k)
+
+        if not safety["safe"]:
+            step_loop.warn_unsafe(k, safety)
+            per_step = []
+            for i, feeds_i in enumerate(step_loop.split_feeds(feed_vals, k)):
+                per_step.append(self.run(
+                    program, feeds_i, fetch_list, scope,
+                    return_numpy=return_numpy, block_id=block_id,
+                    verify=False, steps_per_dispatch=1,
+                    rng_step=(None if rng_step is None
+                              else int(rng_step) + i)))
+            if fetch_every == "last":
+                return per_step[-1]
+            if return_numpy:
+                return [np.stack([outs[j] for outs in per_step])
+                        for j in range(len(fetch_names))]
+            import jax.numpy as jnp
+
+            return [jnp.stack([outs[j] for outs in per_step])
+                    for j in range(len(fetch_names))]
+
+        if block_id == 0:
+            from ..autotune.integration import maybe_apply_program_winner
+
+            maybe_apply_program_winner(program, feed_vals)
+
+        key = self._cache_key(program, block_id, feed_vals, fetch_names) \
+            + ("loop", k, fetch_every)
+        load_sig = self._load_file_sig(program)
+        entry = self._cache.get(key)
+        compiled_now = entry is None or entry[0] != load_sig
+        if compiled_now:
+            with _TRC.span("executor.compile", ops=len(block.ops),
+                           loop_k=k):
+                compiled = self._compile_loop(program, block_id, feed_vals,
+                                              fetch_names, k, fetch_every)
+            self._cache[key] = (load_sig, compiled)
+        else:
+            compiled = entry[1]
+        _MET_PROG_CACHE.inc(result="miss" if compiled_now else "hit")
+
+        import jax
+
+        with _TRC.span("executor.donate", feeds=len(feed)) as sp_don:
+            state_w, state_r = self._pin_state(compiled, scope, block)
+            sp_don.note(donated=len(state_w), reads=len(state_r))
+
+        # the loop folds (base key, step index) per step ON DEVICE —
+        # bitwise the same stream as K sequential host-side fold_ins
+        rng_base = jax.random.PRNGKey(program.random_seed)
+        step0 = np.int32(self._step if rng_step is None else int(rng_step))
+        self._step += k
+
+        def invoke(c):
+            if self._pin_device:
+                with jax.default_device(self.place.jax_device()):
+                    return c.fn(state_w, state_r, feed_vals, rng_base,
+                                step0)
+            return c.fn(state_w, state_r, feed_vals, rng_base, step0)
+
+        try:
+            with _TRC.span("executor.execute",
+                           cache_hit=not compiled_now, loop_k=k):
+                fetches, new_state = invoke(compiled)
+        except Exception as e:
+            # same Mosaic-fallback ladder as the single-step path: retrace
+            # with fused kernels disabled and retry ONCE
+            from ..ops.pallas_kernels import _common as _pk
+
+            if not (_pk.kernels_enabled() and _pk.is_mosaic_error(e)):
+                raise
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in state_w.values()):
+                raise
+            import warnings
+
+            warnings.warn(
+                "fused Pallas kernel failed to compile on this "
+                f"device — falling back to the XLA path for the rest of "
+                f"the process (set PADDLE_TPU_NO_FUSED_KERNELS=1 to skip "
+                f"the attempt): {type(e).__name__}: {str(e)[:300]}")
+            _pk.runtime_disable(f"{type(e).__name__}: {str(e)[:200]}")
+            with _TRC.span("executor.compile", ops=len(block.ops),
+                           loop_k=k, retrace="mosaic_fallback"):
+                compiled = self._compile_loop(program, block_id, feed_vals,
+                                              fetch_names, k, fetch_every)
+            compiled_now = True
+            self._cache[key] = (load_sig, compiled)
+            state_w, state_r = self._pin_state(compiled, scope, block)
+            with _TRC.span("executor.execute", cache_hit=False, loop_k=k):
+                fetches, new_state = invoke(compiled)
+        with _TRC.span("executor.writeback", written=len(new_state)):
+            for n, v in new_state.items():
+                scope.set(n, v)
+        if self.check_nan_inf:
+            for n, v in list(fetches.items()) + list(new_state.items()):
+                arr = np.asarray(v)
+                if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                        np.isfinite(arr)):
+                    raise FloatingPointError(
+                        f"non-finite values in {n!r} after step {self._step}")
+        _MET_STEPS.inc()
+        _acct.on_step(program, _monotime() - t_run0, compiled_now)
+        if return_numpy:
+            return [as_numpy(fetches[n]) for n in fetch_names]
+        return [fetches[n] for n in fetch_names]
+
+    # ------------------------------------------------------------------
     def _verify_program(self, program, block_id, feed_names, fetch_names):
         """Static pre-execution check (the TensorFlow-paper placement/
         well-formedness validation stance): errors raise, warnings log
@@ -541,7 +726,12 @@ class Executor:
         self._verified.add(key)
 
     # ------------------------------------------------------------------
-    def _prepare_feeds(self, block, feed: Dict[str, object]):
+    def _prepare_feeds(self, block, feed: Dict[str, object],
+                       stacked: bool = False):
+        # `stacked`: the values carry a leading steps_per_dispatch dim
+        # (K batches in one dispatch); the base path prepares them the
+        # same way — the flag exists for sharded subclasses, whose feed
+        # shardings must prepend the K dim
         import jax
 
         from ..lod import LENGTH_SUFFIX, as_lod_tensor, is_lod_feed
@@ -623,30 +813,28 @@ class Executor:
 
         return state_classes(block, feed_names, skip_types=_NOOP_TYPES)
 
-    def _compile(self, program, block_id, feed_vals, fetch_names) -> _Compiled:
+    def _emit_ctx(self, rng_key, is_test, program):
+        """EmitContext for one step trace — subclasses attach their mesh."""
+        return EmitContext(rng_key, is_test=is_test, program=program,
+                           place=self.place if self._pin_device else None)
+
+    def _make_step_fn(self, program, block_id, fetch_names, written_state,
+                      is_test, save_specs):
+        """The untraced single-step function `(state_w, state_r, feeds,
+        rng_key) -> (fetches, new_state)` — shared verbatim by the
+        single-step jit (`_compile`) and the K-step scan body
+        (`_compile_loop` via framework/step_loop.py), so the fused loop
+        lowers op-for-op identically to the path it amortizes."""
         import jax
 
         block = program.blocks[block_id]
-        feed_names = list(feed_vals.keys())
-        external_reads, rw_state, written_state = self._analyze(block, feed_names)
-        is_test = not any(
-            op.type.endswith("_grad") or op.type == "generic_grad"
-            for op in block.ops
-        )
-
-        # static save manifest from the descs (save ops inside control-flow
-        # sub-blocks are rejected at emit time, so the top block is complete)
-        save_specs = [(str(op.attrs["file_path"]),
-                       bool(op.attrs.get("overwrite", True)))
-                      for op in block.ops if op.type == "save"]
 
         def step_fn(state_w, state_r, feeds, rng_key):
             env = {}
             env.update(state_r)
             env.update(state_w)
             env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
-            ctx = EmitContext(rng_key, is_test=is_test, program=program,
-                              place=self.place if self._pin_device else None)
+            ctx = self._emit_ctx(rng_key, is_test, program)
 
             def lower_sub(idx, sub_env):
                 ctx.sub_depth += 1
@@ -667,17 +855,82 @@ class Executor:
                     f" but the block declares {save_specs}")
             for i, (_, _, val) in enumerate(ctx.host_saves):
                 fetches[f"{_SAVE_PREFIX}{i}"] = val
-            new_state = {n: env[n] for n in written_state if n in env}
+            if self._strict_state:
+                # sharded subclass: the output pytree must match the
+                # out_shardings built per written_state exactly
+                new_state = {n: env[n] for n in written_state}
+            else:
+                new_state = {n: env[n] for n in written_state if n in env}
             return fetches, new_state
 
-        jitted = jax.jit(step_fn, donate_argnums=(0,))
+        return step_fn
+
+    def _jit_step(self, step_fn, program, external_reads, rw_state,
+                  written_state, feed_names):
+        import jax
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _jit_loop(self, loop_fn, program, external_reads, rw_state,
+                  written_state, feed_names):
+        import jax
+
+        return jax.jit(loop_fn, donate_argnums=(0,))
+
+    def _compile_parts(self, program, block_id, feed_vals, fetch_names):
+        block = program.blocks[block_id]
+        feed_names = list(feed_vals.keys())
+        external_reads, rw_state, written_state = self._analyze(block,
+                                                                feed_names)
+        is_test = not any(
+            op.type.endswith("_grad") or op.type == "generic_grad"
+            for op in block.ops
+        )
+        # static save manifest from the descs (save ops inside control-flow
+        # sub-blocks are rejected at emit time, so the top block is complete)
+        save_specs = [(str(op.attrs["file_path"]),
+                       bool(op.attrs.get("overwrite", True)))
+                      for op in block.ops if op.type == "save"]
+        step_fn = self._make_step_fn(program, block_id, fetch_names,
+                                     written_state, is_test, save_specs)
+        return (step_fn, feed_names, external_reads, rw_state,
+                written_state, save_specs)
+
+    def _compile(self, program, block_id, feed_vals, fetch_names) -> _Compiled:
+        (step_fn, feed_names, external_reads, rw_state, written_state,
+         save_specs) = self._compile_parts(program, block_id, feed_vals,
+                                           fetch_names)
+        jitted = self._jit_step(step_fn, program, external_reads, rw_state,
+                                written_state, feed_names)
         logger.debug(
             "compiled block %d: %d ops, %d reads, %d writes, feeds=%s",
-            block_id, len(block.ops), len(external_reads), len(written_state),
-            feed_names,
+            block_id, len(program.blocks[block_id].ops),
+            len(external_reads), len(written_state), feed_names,
         )
         return _Compiled(jitted, external_reads, rw_state, written_state,
                          fetch_names, save_specs)
+
+    def _compile_loop(self, program, block_id, feed_vals, fetch_names,
+                      k, fetch_every) -> _Compiled:
+        """Fused K-step executable: the SAME step trace as `_compile`,
+        wrapped in the framework/step_loop.py scan."""
+        from . import step_loop
+
+        (step_fn, feed_names, external_reads, rw_state, written_state,
+         save_specs) = self._compile_parts(program, block_id, feed_vals,
+                                           fetch_names)
+        assert not save_specs  # safety_report rejects save ops before here
+        loop_fn = step_loop.build_loop_fn(step_fn, rw_state, k, fetch_every)
+        jitted = self._jit_loop(loop_fn, program, external_reads, rw_state,
+                                written_state, feed_names)
+        logger.debug(
+            "compiled %d-step loop for block %d: %d ops, %d reads, "
+            "%d writes, feeds=%s", k, block_id,
+            len(program.blocks[block_id].ops), len(external_reads),
+            len(written_state), feed_names,
+        )
+        return _Compiled(jitted, external_reads, rw_state, written_state,
+                         fetch_names)
 
     def close(self):
         self._cache.clear()

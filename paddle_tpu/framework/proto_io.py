@@ -3,10 +3,15 @@
 Capability parity with the reference's proto-backed descs (reference:
 paddle/framework/framework.proto, program_desc.cc, python framework.py
 `Program.to_string`/desc round-trip).  The schema lives in
-`framework.proto`; bindings are generated on first use with the baked-in
-`protoc` and cached under `_gen/`.  The same schema is compiled into the
-native desc library (native/program_desc.cc) so C++ tools (prune,
-validate, merge_model) operate on identical bytes.
+`framework.proto`; bindings are generated on first use with `protoc`
+when it is on PATH (cached under `_gen/`), and otherwise constructed AT
+RUNTIME as a FileDescriptorProto in a private DescriptorPool — the
+google.protobuf runtime alone is enough to serialize/parse, so a
+protoc-less container produces the SAME wire bytes (field numbers and
+types are the wire contract; where the classes came from is not).  The
+same schema is compiled into the native desc library
+(native/program_desc.cc) so C++ tools (prune, validate, merge_model)
+operate on identical bytes.
 """
 
 from __future__ import annotations
@@ -37,31 +42,158 @@ def _gen_is_current() -> bool:
 
 
 def proto_bindings_available() -> bool:
-    """True when framework_pb2() can succeed in THIS environment: the
-    generated module is already cached (and current), or `protoc` is on
-    PATH to generate it.  Tests gate protoc-dependent cases on this so a
-    protoc-less environment yields a deterministic skip instead of the
+    """True when framework_pb2() can succeed in THIS environment: all it
+    takes is the google.protobuf runtime — `protoc` is an optimization
+    (cached generated module), never a requirement, since the runtime-
+    descriptor fallback builds identical classes from the schema
+    in-process.  Tests gate proto cases on this so an environment
+    without even the runtime yields a deterministic skip instead of the
     order-dependent pass/fail pair the tier-1 F-stream judgment kept
     tripping over (ISSUE 13 deflake satellite)."""
     import importlib.util as ilu
-    import shutil
 
     if _pb2 is not None:
         return True
-    # the generated module still imports the google.protobuf runtime —
-    # protoc alone is not enough
-    if ilu.find_spec("google.protobuf") is None:
-        return False
-    return _gen_is_current() or shutil.which("protoc") is not None
+    return ilu.find_spec("google.protobuf") is not None
+
+
+def _field(msg, name, number, ftype, label, type_name=None, default=None,
+           packed=None):
+    f = msg.field.add()
+    f.name, f.number, f.type, f.label = name, number, ftype, label
+    if type_name:
+        f.type_name = type_name
+    if default is not None:
+        f.default_value = default
+    if packed is not None:
+        f.options.packed = packed
+    return f
+
+
+def _build_runtime_pb2():
+    """protoc-free bindings: framework.proto re-stated as a runtime
+    FileDescriptorProto in a PRIVATE DescriptorPool (no global-pool
+    collisions), with message classes minted by message_factory.
+
+    Field numbers/types below ARE the framework.proto schema — change
+    them together or the wire format forks.  The round-trip test suite
+    (tests/test_proto_io.py) pins the bytes either path produces."""
+    import types
+
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    F = descriptor_pb2.FieldDescriptorProto
+    OPT, REQ, REP = (F.LABEL_OPTIONAL, F.LABEL_REQUIRED, F.LABEL_REPEATED)
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "paddle_tpu/framework/framework_runtime.proto"
+    fdp.package = "paddle_tpu.framework"
+    fdp.syntax = "proto2"
+
+    attr = fdp.message_type.add()
+    attr.name = "AttrValue"
+    kind = attr.enum_type.add()
+    kind.name = "Kind"
+    for i, n in enumerate(("BOOL", "INT", "FLOAT", "STRING", "INT_LIST",
+                           "FLOAT_LIST", "STRING_LIST", "BOOL_LIST",
+                           "BLOCK", "JSON")):
+        v = kind.value.add()
+        v.name, v.number = n, i
+    _field(attr, "name", 1, F.TYPE_STRING, REQ)
+    _field(attr, "kind", 2, F.TYPE_ENUM, REQ,
+           type_name=".paddle_tpu.framework.AttrValue.Kind")
+    _field(attr, "b", 3, F.TYPE_BOOL, OPT)
+    _field(attr, "i", 4, F.TYPE_INT64, OPT)
+    _field(attr, "f", 5, F.TYPE_DOUBLE, OPT)
+    _field(attr, "s", 6, F.TYPE_STRING, OPT)
+    _field(attr, "int_list", 7, F.TYPE_INT64, REP, packed=True)
+    _field(attr, "float_list", 8, F.TYPE_DOUBLE, REP, packed=True)
+    _field(attr, "string_list", 9, F.TYPE_STRING, REP)
+    _field(attr, "bool_list", 10, F.TYPE_BOOL, REP)
+    _field(attr, "block_idx", 11, F.TYPE_INT32, OPT)
+    _field(attr, "value_json", 12, F.TYPE_STRING, OPT)
+
+    slot = fdp.message_type.add()
+    slot.name = "Slot"
+    _field(slot, "name", 1, F.TYPE_STRING, REQ)
+    _field(slot, "arguments", 2, F.TYPE_STRING, REP)
+
+    opd = fdp.message_type.add()
+    opd.name = "OpDef"
+    _field(opd, "type", 1, F.TYPE_STRING, REQ)
+    _field(opd, "inputs", 2, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.Slot")
+    _field(opd, "outputs", 3, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.Slot")
+    _field(opd, "attrs", 4, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.AttrValue")
+
+    var = fdp.message_type.add()
+    var.name = "VarDef"
+    vkind = var.enum_type.add()
+    vkind.name = "Kind"
+    for i, n in enumerate(("LOD_TENSOR", "SELECTED_ROWS", "FEED_MINIBATCH",
+                           "FETCH_LIST", "STEP_SCOPES", "RANK_TABLE",
+                           "TENSOR_ARRAY", "RAW")):
+        v = vkind.value.add()
+        v.name, v.number = n, i
+    _field(var, "name", 1, F.TYPE_STRING, REQ)
+    _field(var, "kind", 2, F.TYPE_ENUM, OPT,
+           type_name=".paddle_tpu.framework.VarDef.Kind",
+           default="LOD_TENSOR")
+    _field(var, "dtype", 3, F.TYPE_STRING, OPT)
+    _field(var, "shape", 4, F.TYPE_INT64, REP, packed=True)
+    _field(var, "persistable", 5, F.TYPE_BOOL, OPT, default="false")
+    _field(var, "stop_gradient", 6, F.TYPE_BOOL, OPT, default="false")
+    _field(var, "is_parameter", 7, F.TYPE_BOOL, OPT, default="false")
+    _field(var, "trainable", 8, F.TYPE_BOOL, OPT, default="true")
+    _field(var, "partition_spec", 9, F.TYPE_STRING, OPT)
+    _field(var, "lod_level", 10, F.TYPE_INT32, OPT, default="0")
+    _field(var, "is_data", 11, F.TYPE_BOOL, OPT, default="false")
+    _field(var, "accumulator_for", 12, F.TYPE_STRING, OPT)
+
+    blk = fdp.message_type.add()
+    blk.name = "BlockDef"
+    _field(blk, "idx", 1, F.TYPE_INT32, REQ)
+    _field(blk, "parent_idx", 2, F.TYPE_INT32, REQ)
+    _field(blk, "vars", 3, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.VarDef")
+    _field(blk, "ops", 4, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.OpDef")
+
+    prog = fdp.message_type.add()
+    prog.name = "ProgramDef"
+    _field(prog, "blocks", 1, F.TYPE_MESSAGE, REP,
+           type_name=".paddle_tpu.framework.BlockDef")
+    _field(prog, "version", 2, F.TYPE_INT64, OPT, default="1")
+    _field(prog, "random_seed", 3, F.TYPE_INT64, OPT, default="0")
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    mod = types.SimpleNamespace(__name__="framework_pb2_runtime",
+                                DESCRIPTOR=pool.FindFileByName(fdp.name))
+    for name in ("AttrValue", "Slot", "OpDef", "VarDef", "BlockDef",
+                 "ProgramDef"):
+        desc = pool.FindMessageTypeByName(f"paddle_tpu.framework.{name}")
+        setattr(mod, name, message_factory.GetMessageClass(desc))
+    return mod
 
 
 def framework_pb2():
-    """Import (generating if needed) the framework_pb2 module."""
+    """The framework_pb2 bindings: the protoc-generated module when it
+    is cached/generatable, else the runtime-descriptor fallback (same
+    schema, same bytes)."""
     global _pb2
     if _pb2 is not None:
         return _pb2
+    import shutil
+
     gen_py = os.path.join(_GEN_DIR, "framework_pb2.py")
     if not _gen_is_current():
+        if shutil.which("protoc") is None:
+            _pb2 = _build_runtime_pb2()
+            return _pb2
         os.makedirs(_GEN_DIR, exist_ok=True)
         subprocess.run(
             ["protoc", f"--proto_path={_HERE}", f"--python_out={_GEN_DIR}",

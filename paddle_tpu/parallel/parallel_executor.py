@@ -29,7 +29,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..framework.core import np_dtype
-from ..framework.executor import Executor, _lower_ops
+from ..framework.executor import Executor
 from ..framework.scope import global_scope
 from ..ops.registry import EmitContext
 from . import mesh as mesh_lib
@@ -43,6 +43,8 @@ class ParallelExecutor(Executor):
                  zero_dp_states: bool = False, fsdp_params: bool = False):
         super().__init__(place=None)
         self._pin_device = False
+        # the step output pytree must match out_shardings exactly
+        self._strict_state = True
         self.mesh = mesh if mesh is not None else make_mesh(axes, devices)
         self.transpiler = DistributeTranspiler(
             rules, zero_dp_states=zero_dp_states, fsdp_params=fsdp_params)
@@ -118,7 +120,15 @@ class ParallelExecutor(Executor):
         return out
 
     # ------------------------------------------------------------------
-    def _prepare_feeds(self, block, feed):
+    def _stacked_sharding(self, sharding):
+        """The sharding of a leading-stacked (K, ...) feed block: the
+        planned per-batch spec with the steps_per_dispatch dim
+        unsharded in front (every device sees all K of its slices)."""
+        from .mesh import named
+
+        return named(sharding.mesh, None, *sharding.spec)
+
+    def _prepare_feeds(self, block, feed, stacked: bool = False):
         import jax
 
         program = block.program
@@ -136,6 +146,8 @@ class ParallelExecutor(Executor):
                 sharding = plan.get(name) or self._replicated()
             else:
                 sharding = self._replicated()
+            if stacked:
+                sharding = self._stacked_sharding(sharding)
             out[name] = jax.device_put(arr, sharding)
         return out
 
@@ -160,7 +172,8 @@ class ParallelExecutor(Executor):
             scope.set(n, jax.device_put(v, target))
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, block_id=0, verify=None, rng_step=None):
+            return_numpy=True, block_id=0, verify=None, rng_step=None,
+            steps_per_dispatch=None, fetch_every="all"):
         from ..framework.core import default_main_program
 
         program = program if program is not None else default_main_program()
@@ -174,42 +187,34 @@ class ParallelExecutor(Executor):
         self._distribute_state(
             program, scope, [n for n in names if scope.has(n)])
         return super().run(program, feed, fetch_list, scope, return_numpy,
-                           block_id, verify=verify, rng_step=rng_step)
+                           block_id, verify=verify, rng_step=rng_step,
+                           steps_per_dispatch=steps_per_dispatch,
+                           fetch_every=fetch_every)
 
     # ------------------------------------------------------------------
-    def _compile(self, program, block_id, feed_vals, fetch_names):
+    # the step trace itself comes from Executor._make_step_fn (shared
+    # with the single-chip path and the K-step loop); only the emit
+    # context (mesh) and the jit shardings differ here
+
+    def _emit_ctx(self, rng_key, is_test, program):
+        ctx = EmitContext(rng_key, is_test=is_test, program=program)
+        ctx.mesh = self.mesh
+        return ctx
+
+    def _compile_parts(self, program, block_id, feed_vals, fetch_names):
+        if any(op.type == "save"
+               for op in program.blocks[block_id].ops):
+            raise NotImplementedError(
+                "save ops are not supported under ParallelExecutor; "
+                "checkpoint sharded state via distributed.checkpoint")
+        return super()._compile_parts(program, block_id, feed_vals,
+                                      fetch_names)
+
+    def _jit_step(self, step_fn, program, external_reads, rw_state,
+                  written_state, feed_names):
         import jax
 
-        block = program.blocks[block_id]
-        feed_names = list(feed_vals.keys())
-        external_reads, rw_state, written_state = self._analyze(
-            block, feed_names)
-        is_test = not any(
-            op.type.endswith("_grad") or op.type == "generic_grad"
-            for op in block.ops
-        )
         plan, _ = self._plan_for(program)
-
-        def step_fn(state_w, state_r, feeds, rng_key):
-            env = {}
-            env.update(state_r)
-            env.update(state_w)
-            env.update({n: jax.numpy.asarray(v) for n, v in feeds.items()})
-            ctx = EmitContext(rng_key, is_test=is_test, program=program)
-            ctx.mesh = self.mesh
-            ctx.lower_block = lambda idx, sub_env: _lower_ops(
-                program.blocks[idx].ops, sub_env, ctx)
-            _lower_ops(block.ops, env, ctx)
-            if ctx.host_saves:
-                raise NotImplementedError(
-                    "save ops are not supported under ParallelExecutor; "
-                    "checkpoint sharded state via distributed.checkpoint")
-            fetches = {n: env[n] for n in fetch_names}
-            # no `if in env` guard: out_shardings is built per written_state,
-            # so the output pytree structure must match it exactly
-            new_state = {n: env[n] for n in written_state}
-            return fetches, new_state
-
         in_shardings = (
             {n: self._shard_of(plan, n) for n in rw_state},
             {n: self._shard_of(plan, n) for n in external_reads},
@@ -221,13 +226,36 @@ class ParallelExecutor(Executor):
             None,
             {n: self._shard_of(plan, n) for n in written_state},
         )
-        jitted = jax.jit(
+        return jax.jit(
             step_fn,
             donate_argnums=(0,),
             in_shardings=in_shardings,
             out_shardings=out_shardings,
         )
-        from ..framework.executor import _Compiled
 
-        return _Compiled(jitted, external_reads, rw_state, written_state,
-                         fetch_names)
+    def _jit_loop(self, loop_fn, program, external_reads, rw_state,
+                  written_state, feed_names):
+        import jax
+
+        plan, _ = self._plan_for(program)
+        in_shardings = (
+            {n: self._shard_of(plan, n) for n in rw_state},
+            {n: self._shard_of(plan, n) for n in external_reads},
+            # stacked (K, batch, ...) feed blocks: the planned per-batch
+            # spec shifted one dim right — sharded state stays resident
+            # across the whole loop, only the feeds carry the K dim
+            {n: self._stacked_sharding(plan.get(n) or self._replicated())
+             for n in feed_names},
+            self._replicated(),
+            self._replicated(),
+        )
+        out_shardings = (
+            None,
+            {n: self._shard_of(plan, n) for n in written_state},
+        )
+        return jax.jit(
+            loop_fn,
+            donate_argnums=(0,),
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+        )

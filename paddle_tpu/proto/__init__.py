@@ -12,7 +12,12 @@ types (trainer/PyDataProvider2).  `framework_pb2` is the generated
 module; the reference names alias it so `from paddle.proto import
 ModelConfig_pb2` still imports."""
 
-from ..framework._gen import framework_pb2  # noqa: F401
+from ..framework import proto_io as _proto_io
+
+# Resolved through proto_io so the protoc-less runtime-descriptor
+# fallback serves this namespace too (ISSUE 20): cached generated module
+# when present, else classes minted from a runtime FileDescriptorProto.
+framework_pb2 = _proto_io.framework_pb2()
 
 # reference module names -> the one interchange schema
 ModelConfig_pb2 = framework_pb2

@@ -39,6 +39,13 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python tools/hlo_analysis.py hybrid > /dev/null \
     || { echo "hybrid-mesh bitwise parity gate failed (rc=$?)"; exit 1; }
 
+# fused step-loop parity gate (ISSUE 20): K training steps compiled as
+# ONE dispatch (lax.scan over stacked feeds, framework/step_loop.py)
+# must match K sequential run() calls BITWISE — per-step fetches AND all
+# written state — on an MLP and a small LM, K in {1,4}
+JAX_PLATFORMS=cpu python tools/hlo_analysis.py loop --ks 1,4 > /dev/null \
+    || { echo "step-loop bitwise parity gate failed (rc=$?)"; exit 1; }
+
 # telemetry smoke (docs/observability.md ISSUE 13): a traced fit-a-line
 # train step through the unified telemetry layer — asserts the executor
 # phase spans exist, the Perfetto trace and metrics snapshot are
@@ -63,6 +70,11 @@ env JAX_PLATFORMS=cpu python -m paddle_tpu tune spec_decode --smoke \
 env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m paddle_tpu tune mesh_layout --smoke \
     || { echo "mesh_layout autotune smoke failed (rc=$?)"; exit 1; }
+# the ISSUE 20 steps_per_dispatch axis: fused-K candidates ranked by the
+# amortized dispatch-overhead model (cost.step_loop_cost), winner lands
+# in the store and resolves through knobs.steps_per_dispatch
+env JAX_PLATFORMS=cpu python -m paddle_tpu tune step_loop --smoke \
+    || { echo "step_loop autotune smoke failed (rc=$?)"; exit 1; }
 
 # attribution smoke + regression sentinel (docs/observability.md ISSUE
 # 16): `paddle attribute` runs the deterministic CPU segment oracle

@@ -28,7 +28,9 @@ def _run(feeds, fetch):
     return exe.run(feed=feeds, fetch_list=list(fetch))
 
 
-@needs_protoc
+@pytest.mark.skipif(
+    not __import__("os").path.isdir("/root/reference/python/paddle/v2/fluid"),
+    reason="reference fluid source tree not present in this image")
 def test_reference_fluid_all_names_exist():
     import re, ast
     for mod in ["nn", "tensor", "control_flow", "io", "device"]:

@@ -2,6 +2,7 @@
 layout-aware op and the whole ResNet block must match its NCHW result."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -111,6 +112,10 @@ def _model_logits(model, layout, x_nchw):
     return np.asarray(_run({"x": feed}, out))
 
 
+# ~50s (three full CNN builds x two layouts).  The unfiltered
+# run_tests.sh pass still runs it; the 'not slow' fast tier skips it to
+# stay inside its wall-clock budget (ISSUE 20).
+@pytest.mark.slow
 def test_bench_cnn_models_nhwc_match_nchw():
     """The opt-in bench CNNs (alexnet, googlenet incl. inception concat
     axis, vgg16 via img_conv_group) produce the same logits in NHWC as

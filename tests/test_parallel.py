@@ -470,6 +470,10 @@ def test_program_pipeline_second_batch_size():
                   "y": rng.rand(7, 1).astype(np.float32)})
 
 
+# ~70s of compiles: the heaviest single test in the suite.  run_tests.sh's
+# unfiltered pytest pass still runs it; only the 'not slow' fast tier
+# skips it to stay inside its wall-clock budget (ISSUE 20).
+@pytest.mark.slow
 @isolated_native("parallel_tail_3")
 def test_sharded_checkpoint_roundtrip(tmp_path):
     """Checkpoint/resume of a dp+mp-sharded (and ZeRO-state-sharded) scope:

@@ -1,6 +1,7 @@
 """ResNet model-zoo smoke: tiny cifar ResNet trains end-to-end."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import resnet
@@ -48,6 +49,10 @@ def test_resnet50_imagenet_builds():
     assert logits.shape[-1] == 1000
 
 
+# ~30s (two full ResNet-50 builds).  The unfiltered run_tests.sh pass
+# still runs it; the 'not slow' fast tier skips it to stay inside its
+# wall-clock budget (ISSUE 20).
+@pytest.mark.slow
 def test_resnet_remat_matches_plain_numerics():
     """layers.recompute per residual block (the bench remat config) must be
     numerically identical to the plain build — remat changes WHERE

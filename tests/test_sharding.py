@@ -562,6 +562,34 @@ def test_hybrid_allreduce_decomposition_bytes_exact():
     assert rep2["link_bytes"]["dcn"] == int(2 * 1 / 2 * b)
 
 
+def test_hybrid_allgather_decomposition_bytes_exact():
+    """One all-gather over ("dcn_dp","dp") on a 2-slice 4x mesh prices
+    hierarchically (ISSUE 20): DCN all-gathers the 1/n_ici co-shard
+    first ((n_d-1)/n_d of bytes/n_ici), then a per-slice ICI all-gather
+    completes the buffer ((n_i-1)/n_i of the full bytes) — vs a flat
+    pricing that would push (n-1)/n of the FULL buffer over DCN."""
+    b = 1 << 20
+    ana = ash.ShardingAnalysis(axis_sizes={"dp": 4, "dcn_dp": 2})
+    ana.collectives.append(
+        ash.Collective("all-gather", ("dcn_dp", "dp"), b))
+    rep = ash.comm_report(ana, chip="v5e")
+    w_dcn = (2 - 1) / 2 * (b // 4)
+    w_ici = (4 - 1) / 4 * b
+    assert rep["link_bytes"] == {"ici": int(w_ici), "dcn": int(w_dcn)}
+    dec = rep["breakdown"][0]["decomposed"]
+    assert dec["dcn_all_gather_bytes"] == int(w_dcn)
+    assert dec["ici_all_gather_bytes"] == int(w_ici)
+    # the decomposition is what the hybrid buys: flat pricing would put
+    # (n-1)/n of the full buffer on the slow link
+    assert w_dcn < (8 - 1) / 8 * b
+    # single-class all-gathers still price flat, no decomposed entry
+    ana2 = ash.ShardingAnalysis(axis_sizes={"dp": 4, "dcn_dp": 2})
+    ana2.collectives.append(ash.Collective("all-gather", ("dp",), b))
+    rep2 = ash.comm_report(ana2, chip="v5e")
+    assert "decomposed" not in rep2["breakdown"][0]
+    assert rep2["link_bytes"] == {"ici": int(3 / 4 * b), "dcn": 0}
+
+
 def test_hybrid_mesh_step_link_bytes_per_collective():
     """The dp-MLP training step planned on the 2-slice mesh: every
     gradient all-reduce spans both link classes and its breakdown entry

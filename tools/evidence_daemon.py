@@ -352,6 +352,17 @@ CAPTURES = [
     # cross-replica weight-update sharding
     ("hybrid_parity",
      [sys.executable, "tools/hlo_analysis.py", "hybrid"], {}, 900),
+    # fused K-step dispatch (ISSUE 20): the steps_per_dispatch sweep's
+    # on-chip steps/s per K with predicted-vs-measured amortization
+    # error, plus the K∈{2,4,8} bitwise loop-parity verdict — the
+    # first on-chip row for the device-resident training loop
+    ("step_loop_bench",
+     [sys.executable, "bench.py"],
+     {"BENCH_MODEL": "step_loop", "BENCH_NO_PREFLIGHT": "1",
+      "BENCH_ITERS": "30"}, 580),
+    ("step_loop_parity",
+     [sys.executable, "tools/hlo_analysis.py", "loop",
+      "--ks", "2,4,8"], {}, 900),
     # chaos matrix (ISSUE 12): the elastic-service fault catalog (worker
     # kill mid-pass, kill-during-checkpoint, master death, heartbeat
     # stall, corrupt checkpoint) x 2 seeds, every cell's recovery

@@ -747,6 +747,22 @@ def run_hybrid(args) -> None:
         sys.exit(1)
 
 
+def run_loop(args) -> None:
+    """The fused K-step dispatch parity capture: for each K in --ks
+    and each standing model (MLP + small LM), one fused
+    steps_per_dispatch=K run vs K sequential dispatches, bitwise on
+    every per-step fetch AND all written state — the
+    framework/step_loop.py contract.  Exits 1 unless every case is
+    PROVEN — run_tests.sh's `loop` gate."""
+    from paddle_tpu.analysis import equivalence as eqv
+
+    ks = tuple(int(k) for k in (args.ks or "1,4").split(","))
+    rec = eqv.loop_parity_report(ks=ks)
+    print(json.dumps(rec), flush=True)
+    if rec["verdict"] != "PROVEN":
+        sys.exit(1)
+
+
 def analyze_roofline(args) -> None:
     """Driver half of the roofline capture: run the child (accelerator-
     honoring, like bytes mode), pass its JSON line through."""
@@ -762,7 +778,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
                     choices=["bytes", "collectives", "peak", "roofline",
-                             "comm", "equiv", "hybrid", "all"])
+                             "comm", "equiv", "hybrid", "loop", "all"])
     ap.add_argument("--child", default=None)
     ap.add_argument("--mode", dest="submode", default=None)
     ap.add_argument("--bs", type=int, default=32)
@@ -775,6 +791,9 @@ def main():
     ap.add_argument("--tpu", action="store_true",
                     help="bytes mode: use the environment's accelerator "
                          "instead of defaulting to cpu")
+    ap.add_argument("--ks", default=None,
+                    help="loop mode: comma-separated steps_per_dispatch "
+                         "values to prove (default 1,4)")
     ap.add_argument("--capture-golden", action="store_true",
                     dest="capture_golden",
                     help="equiv mode: after a fully PROVEN sweep, "
@@ -807,6 +826,9 @@ def main():
         return
     if args.what == "hybrid":
         run_hybrid(args)
+        return
+    if args.what == "loop":
+        run_loop(args)
         return
     if args.what in ("bytes", "all"):
         for fuse in ((False, True) if args.what == "all"

@@ -336,11 +336,13 @@ def _check_ckpt_writes(root, dirpath, filenames, findings):
 # override (and route the read through autotune/knobs.py).
 _KNOB_ENV_RE = re.compile(
     r"os\.environ\b[^\n]*PADDLE_TPU_(?:FLASH_|BNCONV_|PAGE_SIZE"
-    r"|AUTOTUNE\b|SPEC_K\b|SPEC_DRAFT_LAYERS)")
-# plain assignments are the EXPORT side of the knob layer (a bench
-# pinning its config so knobs.py resolves it for the whole process) —
-# only raw reads bypass validation/precedence and get flagged
-_KNOB_ENV_WRITE_RE = re.compile(r"os\.environ\[[^\]]+\]\s*=")
+    r"|AUTOTUNE\b|SPEC_K\b|SPEC_DRAFT_LAYERS|STEPS_PER_DISPATCH)")
+# plain assignments (and the matching teardown pop) are the EXPORT side
+# of the knob layer (a bench pinning its config so knobs.py resolves it
+# for the whole process) — only raw reads bypass validation/precedence
+# and get flagged
+_KNOB_ENV_WRITE_RE = re.compile(
+    r"os\.environ\[[^\]]+\]\s*=|os\.environ\.pop\(")
 _KNOB_ENV_DIRS = ("paddle_tpu", "tools")
 _KNOB_ENV_OK_DIR = os.path.join("paddle_tpu", "autotune")
 
@@ -457,6 +459,45 @@ def _check_truncated(root, dirpath, filenames, findings):
             pass
 
 
+# the training-loop mint guard (ISSUE 20): lax.scan inside
+# paddle_tpu/framework/ outside framework/step_loop.py.  The fused
+# K-step dispatch has ONE home — step_loop.build_loop_fn owns the RNG
+# fold-in schedule, the donated-carry layout, and the bitwise parity
+# obligation (tools/hlo_analysis.py loop) — a second scan-based training
+# loop would fork those contracts unproven.  Assembled so this file does
+# not flag itself.
+_SCAN_RE = re.compile(r"\blax\.sc" + r"an\s*\(")
+_SCAN_DIR = os.path.join("paddle_tpu", "framework")
+_SCAN_OK = {
+    os.path.join("paddle_tpu", "framework", "step_loop.py"),
+}
+
+
+def _check_scan_loop(root, dirpath, filenames, findings):
+    rel_dir = os.path.relpath(dirpath, root)
+    if rel_dir != _SCAN_DIR and not rel_dir.startswith(_SCAN_DIR + os.sep):
+        return
+    for fname in filenames:
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(dirpath, fname)
+        rel = os.path.relpath(path, root)
+        if rel in _SCAN_OK:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if _SCAN_RE.search(line):
+                        findings.append(
+                            f"scan training loop outside step_loop: "
+                            f"{rel}:{i} (framework/step_loop.py is the "
+                            f"one home of the fused K-step dispatch — "
+                            f"it owns the RNG fold-in schedule and the "
+                            f"bitwise loop-parity proof)")
+        except OSError:
+            pass
+
+
 # the PTV rule/doc drift guard: rule registrations in verifier.py vs
 # catalog rows in docs/analysis.md
 _RULE_DEF_RE = re.compile(r"Rule\(\s*\"(PTV\d{3})\"")
@@ -535,6 +576,7 @@ def lint(root: str):
         _check_ckpt_writes(root, dirpath, filenames, findings)
         _check_named_scope(root, dirpath, filenames, findings)
         _check_truncated(root, dirpath, filenames, findings)
+        _check_scan_loop(root, dirpath, filenames, findings)
         if parts and parts[0] in _NO_INIT_OK:
             continue
         has_py = any(f.endswith(".py") for f in filenames)

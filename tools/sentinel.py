@@ -41,7 +41,7 @@ from typing import Dict, List, Optional, Tuple
 
 _HIGHER_IS_BETTER = ("coverage", "speedup", "mfu", "throughput",
                      "tokens_per", "fraction", "accuracy", "hit_rate",
-                     "goodput")
+                     "goodput", "steps_per_s")
 _LOWER_IS_BETTER = ("time", "_ms", "latency", "seconds", "step_s",
                     "rank_error", "bytes", "peak", "p50", "p99",
                     "stall", "overhead")
@@ -241,6 +241,18 @@ def self_test() -> int:
     rep2 = compare(base, low)
     by2 = {m["metric"]: m for m in rep2["metrics"]}
     assert by2["op_attribution_fit_a_line"]["verdict"] == "REGRESSED"
+
+    # the ISSUE 20 step_loop artifact: steps/s is higher-is-better (a
+    # drop regresses), despite "step" also living in lower-is-better
+    # latency names like step_s/step_ms
+    assert polarity("step_loop_steps_per_s_k8", "steps/s") == 1
+    sl_base = {"step_loop_steps_per_s_k8": {
+        "metric": "step_loop_steps_per_s_k8", "value": 22000.0,
+        "unit": "steps/s"}}
+    sl_bad = json.loads(json.dumps(sl_base))
+    sl_bad["step_loop_steps_per_s_k8"]["value"] = 11000.0
+    rep3 = compare(sl_base, sl_bad)
+    assert rep3["verdict"] == "REGRESSED", rep3
 
     print("# sentinel self-test OK (identical=PASS, injected slowdown="
           "REGRESSED w/ guilty op, rank gain=IMPROVED, in-spread "
